@@ -1,0 +1,17 @@
+"""Repo-level pytest config: force a deterministic 8-device CPU mesh.
+
+Sharding / halo-exchange logic is tested without TPU hardware via
+XLA's host-platform device virtualization (SURVEY.md §4: "CPU tests
+with xla_force_host_platform_device_count=8"). Must run before jax
+initializes, hence env vars set at conftest import time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("MPLBACKEND", "Agg")

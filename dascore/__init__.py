@@ -1,0 +1,27 @@
+"""DASCore-compatible API shim backed by tpudas.
+
+The four reference notebooks (and lf_das.py itself) consume DASCore as
+``import dascore as dc`` (SURVEY.md §2.3). This package re-exports the
+tpudas implementations under that name so those workflows run unchanged
+against the TPU engine. No DASCore code is used — everything resolves to
+tpudas.
+"""
+
+from tpudas import (
+    Patch,
+    spool,
+    to_datetime64,
+    to_timedelta64,
+    __version__,
+)
+from dascore import units, utils
+
+__all__ = [
+    "Patch",
+    "spool",
+    "to_datetime64",
+    "to_timedelta64",
+    "units",
+    "utils",
+    "__version__",
+]

@@ -1,0 +1,5 @@
+"""dascore.units shim → tpudas.core.units (``from dascore.units import s``)."""
+
+from tpudas.core.units import Quantity, Unit, ns, us, ms, s, minute, h, get_seconds
+
+__all__ = ["Quantity", "Unit", "ns", "us", "ms", "s", "minute", "h", "get_seconds"]

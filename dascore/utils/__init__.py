@@ -1,0 +1,5 @@
+"""dascore.utils shim."""
+
+from dascore.utils import mapping
+
+__all__ = ["mapping"]

@@ -1,0 +1,5 @@
+"""dascore.utils.mapping shim (``FrozenDict`` — reference lf_das.py:12)."""
+
+from tpudas.core.mapping import FrozenDict
+
+__all__ = ["FrozenDict"]

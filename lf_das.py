@@ -1,0 +1,33 @@
+"""lf_das compatibility module backed by tpudas.
+
+The reference notebooks import the processing layer as
+``from lf_das import LFProc, get_edge_effect_time, get_patch_time,
+waterfall_plot`` (low_pass_dascore.ipynb:56) and the private naming
+helper ``from lf_das import _get_filename``
+(rolling_mean_dascore.ipynb:56). This module maps those names onto the
+tpudas implementations so the notebooks run unchanged on the TPU
+engine. Underscored aliases mirror the reference's private names.
+"""
+
+from tpudas.proc.lfproc import LFProc, check_merge as _check_merge
+from tpudas.proc.naming import (
+    get_timestr as _get_timestr,
+    get_filename as _get_filename,
+)
+from tpudas.proc.edge import (
+    down_sample_processing as _down_sample_processing,
+    get_edge_effect_time,
+)
+from tpudas.proc.memory import get_patch_time
+from tpudas.viz.waterfall import waterfall_plot
+
+__all__ = [
+    "LFProc",
+    "get_edge_effect_time",
+    "get_patch_time",
+    "waterfall_plot",
+    "_check_merge",
+    "_get_timestr",
+    "_get_filename",
+    "_down_sample_processing",
+]

@@ -1,0 +1,159 @@
+"""Core data layer: FrozenDict, time utils, units, attrs aliases, Patch."""
+
+import numpy as np
+import pytest
+
+from tpudas.core.attrs import PatchAttrs
+from tpudas.core.mapping import FrozenDict
+from tpudas.core import units
+from tpudas.core.timeutils import (
+    build_time_grid,
+    quantize_step,
+    to_datetime64,
+    to_float_seconds,
+    to_timedelta64,
+)
+from tpudas.core.patch import Patch
+from tpudas.testing import synthetic_patch
+
+
+class TestFrozenDict:
+    def test_mapping(self):
+        fd = FrozenDict(a=1, b=2)
+        assert fd["a"] == 1 and len(fd) == 2 and set(fd) == {"a", "b"}
+
+    def test_immutable(self):
+        fd = FrozenDict(a=1)
+        with pytest.raises(TypeError):
+            fd["a"] = 2  # type: ignore[index]
+
+    def test_updated(self):
+        fd = FrozenDict(a=1).updated(b=2)
+        assert dict(fd) == {"a": 1, "b": 2}
+
+
+class TestTimeUtils:
+    def test_float_seconds_roundtrip(self):
+        t = to_datetime64(1234.5)
+        assert to_float_seconds(t) == 1234.5
+
+    def test_negative_seconds(self):
+        # the impulse probe builds a time axis centred on zero
+        t = to_datetime64(np.array([-2.0, -1.0, 0.0, 1.0]))
+        assert np.all(np.diff(t) == np.timedelta64(1_000_000_000, "ns"))
+        assert to_float_seconds(t)[0] == -2.0
+
+    def test_string_parse(self):
+        t = to_datetime64("2023-03-22 03:00:00")
+        assert t == np.datetime64("2023-03-22T03:00:00", "ns")
+
+    def test_timedelta(self):
+        assert to_timedelta64(0.001) == np.timedelta64(1_000_000, "ns")
+        assert to_timedelta64(10 * units.s) == np.timedelta64(10, "s")
+
+    def test_quantize_step_ms_contract(self):
+        # reference grid step: timedelta64(int(dt*1000), "ms")
+        assert quantize_step(10.0) == np.timedelta64(10000, "ms")
+        assert quantize_step(0.5) == np.timedelta64(500, "ms")
+
+    def test_build_time_grid(self):
+        grid = build_time_grid("2023-01-01", "2023-01-01T00:01:00", 10.0)
+        assert len(grid) == 6
+        assert grid[1] - grid[0] == np.timedelta64(10, "s")
+
+
+class TestUnits:
+    def test_quantity_seconds(self):
+        q = 10.0 * units.s
+        assert q.to_seconds() == 10.0
+        assert units.get_seconds(q) == 10.0
+
+    def test_get_seconds_passthrough(self):
+        assert units.get_seconds(2.5) == 2.5
+        assert units.get_seconds(np.timedelta64(1500, "ms")) == 1.5
+        assert units.get_seconds(None, 7) == 7
+
+
+class TestAttrsAliases:
+    def test_three_generations(self):
+        # the 3 spellings the notebooks use (SURVEY.md §2.3)
+        a = PatchAttrs({"d_time": 0.001, "d_distance": 5.0})
+        assert a["time_step"] == np.timedelta64(1_000_000, "ns")
+        assert a["step_time"] == a["d_time"] == a["time_step"]
+        assert a["distance_step"] == a["step_distance"] == 5.0
+
+    def test_notebook_sampling_rate_idiom(self):
+        a = PatchAttrs({"time_step": np.timedelta64(1, "ms")})
+        rate = 1 / (a["time_step"] / np.timedelta64(1, "s"))
+        assert rate == 1000.0
+
+    def test_update_via_alias(self):
+        a = PatchAttrs({"time_step": 0.001}).updated(d_time=10.0)
+        assert a["step_time"] == np.timedelta64(10, "s")
+
+
+class TestPatch:
+    def make(self, n=100, c=4):
+        return synthetic_patch(duration=n / 200.0, fs=200.0, n_ch=c)
+
+    def test_construction_derives_attrs(self):
+        p = self.make()
+        assert p.attrs["time_min"] == p.coords["time"][0]
+        assert p.attrs["time_max"] == p.coords["time"][-1]
+        assert p.attrs["time_step"] == np.timedelta64(5_000_000, "ns")
+        assert p.attrs["distance_step"] == 5.0
+        assert p.attrs["gauge_length"] == 10.0
+
+    def test_immutable(self):
+        p = self.make()
+        with pytest.raises(TypeError):
+            p.data = None  # type: ignore[misc]
+
+    def test_new_data(self):
+        p = self.make()
+        q = p.new(data=p.host_data() * 2)
+        assert np.allclose(q.host_data(), p.host_data() * 2)
+        assert q.attrs["gauge_length"] == p.attrs["gauge_length"]
+
+    def test_update_attrs_keeps_coord_extrema(self):
+        p = self.make()
+        q = p.update_attrs(d_time=10.0)
+        assert q.attrs["time_step"] == np.timedelta64(10, "s")
+        assert q.attrs["time_min"] == p.attrs["time_min"]
+
+    def test_select_time_inclusive(self):
+        p = self.make()
+        t = p.coords["time"]
+        q = p.select(time=(t[10], t[20]))
+        assert q.shape[0] == 11
+        assert q.attrs["time_min"] == t[10]
+
+    def test_select_distance(self):
+        p = self.make()
+        d = p.coords["distance"]
+        q = p.select(distance=(d[1], d[2]))
+        assert q.shape[1] == 2
+
+    def test_select_string_time(self):
+        p = self.make()
+        q = p.select(time=("2023-03-22T00:00:00.1", None))
+        assert q.shape[0] < p.shape[0]
+
+    def test_pipe(self):
+        p = self.make()
+        out = p.pipe(lambda patch, k: patch.new(data=patch.host_data() * k), k=3)
+        assert np.allclose(out.host_data(), p.host_data() * 3)
+
+    def test_dropna(self):
+        p = self.make()
+        data = p.host_data().copy()
+        data[:5] = np.nan
+        q = p.new(data=data).dropna("time")
+        assert q.shape[0] == p.shape[0] - 5
+        assert q.attrs["time_min"] == p.coords["time"][5]
+
+    def test_coords_indexing_idiom(self):
+        # notebooks do patch.coords['distance'][ch] and len(coords['time'])
+        p = self.make()
+        assert p.coords["distance"][2] == 10.0
+        assert len(p.coords["time"]) == p.shape[0]

@@ -1,0 +1,160 @@
+"""IO round-trip, scanning, directory indexing, spool semantics."""
+
+import os
+
+import numpy as np
+import pytest
+
+from tpudas import spool
+from tpudas.io.registry import read_file, scan_file, write_patch
+from tpudas.io.spool import MemorySpool, merge_patches
+from tpudas.proc.lfproc import check_merge
+from tpudas.testing import make_synthetic_spool, synthetic_patch
+
+
+@pytest.fixture
+def spool_dir(tmp_path):
+    d = tmp_path / "raw"
+    make_synthetic_spool(d, n_files=4, file_duration=30.0, fs=100.0, n_ch=8)
+    return str(d)
+
+
+class TestDasdaeIO:
+    def test_roundtrip(self, tmp_path):
+        p = synthetic_patch(duration=5, fs=100.0, n_ch=8, noise=0.1)
+        path = str(tmp_path / "x.h5")
+        p.io.write(path, "dasdae")
+        q = read_file(path)[0]
+        assert np.allclose(q.host_data(), p.host_data())
+        assert np.array_equal(q.coords["time"], p.coords["time"])
+        assert np.array_equal(q.coords["distance"], p.coords["distance"])
+        assert q.attrs["gauge_length"] == 10.0
+        assert q.attrs["time_step"] == p.attrs["time_step"]
+
+    def test_scan_metadata_only(self, tmp_path):
+        p = synthetic_patch(duration=5, fs=100.0, n_ch=8)
+        path = str(tmp_path / "x.h5")
+        write_patch(p, path)
+        info = scan_file(path)[0]
+        assert info["time_min"] == p.attrs["time_min"]
+        assert info["time_max"] == p.attrs["time_max"]
+        assert info["ntime"] == 500 and info["ndistance"] == 8
+        assert info["distance_max"] == 35.0
+
+    def test_range_sliced_read(self, tmp_path):
+        p = synthetic_patch(duration=10, fs=100.0, n_ch=8)
+        path = str(tmp_path / "x.h5")
+        write_patch(p, path)
+        t = p.coords["time"]
+        q = read_file(path, time=(t[100], t[199]), distance=(10.0, 20.0))[0]
+        assert q.shape == (100, 3)
+        assert q.attrs["time_min"] == t[100]
+
+    def test_unknown_format_raises(self, tmp_path):
+        p = synthetic_patch(duration=1, fs=100.0, n_ch=2)
+        with pytest.raises(ValueError, match="unknown IO format"):
+            p.io.write(str(tmp_path / "x.h5"), "not_a_format")
+
+
+class TestDirectorySpool:
+    def test_update_and_len(self, spool_dir):
+        sp = spool(spool_dir).sort("time").update()
+        assert len(sp) == 4
+
+    def test_lazy_index_without_update(self, spool_dir):
+        # notebook cell 11: dc.spool(output).chunk(time=None) w/o update()
+        sp = spool(spool_dir)
+        assert len(sp) == 4
+
+    def test_incremental_update_picks_up_new_files(self, spool_dir):
+        sp = spool(spool_dir).update()
+        assert len(sp) == 4
+        make_synthetic_spool(
+            spool_dir, n_files=6, file_duration=30.0, fs=100.0, n_ch=8
+        )
+        sp2 = spool(spool_dir).update()
+        assert len(sp2) == 6
+
+    def test_getitem_negative(self, spool_dir):
+        sp = spool(spool_dir).sort("time").update()
+        last = sp[-1]
+        first = sp[0]
+        assert last.attrs["time_min"] > first.attrs["time_min"]
+
+    def test_get_contents_dataframe(self, spool_dir):
+        df = spool(spool_dir).update().get_contents()
+        assert len(df) == 4
+        assert {"time_min", "time_max"} <= set(df.columns)
+
+    def test_select_time_filters_files(self, spool_dir):
+        sp = spool(spool_dir).update()
+        t0 = sp[0].attrs["time_min"]
+        sub = sp.select(time=(t0, t0 + np.timedelta64(35, "s")))
+        assert len(sub) == 2  # only first two files overlap
+
+    def test_select_distance_trims(self, spool_dir):
+        sp = spool(spool_dir).update()
+        sub = sp.select(distance=(10.0, 20.0))
+        assert sub[0].shape[1] == 3
+
+    def test_select_string_times(self, spool_dir):
+        sp = spool(spool_dir).update()
+        sub = sp.select(time=("2023-03-22T00:00:00", "2023-03-22T00:00:29"))
+        assert len(sub) >= 1
+
+    def test_chunk_merges_contiguous(self, spool_dir):
+        merged = spool(spool_dir).update().chunk(time=None)
+        assert len(merged) == 1
+        p = check_merge(list(merged))
+        assert p.shape == (4 * 3000, 8)
+        # time axis strictly increasing, uniform
+        steps = np.diff(p.coords["time"].astype(np.int64))
+        assert np.all(steps == steps[0])
+
+    def test_gap_detection(self, tmp_path):
+        d = tmp_path / "gappy"
+        make_synthetic_spool(d, n_files=2, file_duration=30.0, fs=100.0, n_ch=4)
+        make_synthetic_spool(
+            d, n_files=1, file_duration=30.0, fs=100.0, n_ch=4,
+            start="2023-03-22T01:00:00",
+        )
+        merged = spool(str(d)).update().chunk(time=None)
+        assert len(merged) == 2
+        with pytest.raises(Exception, match="Gap in data exists"):
+            check_merge(list(merged))
+
+    def test_spool_of_spool_passthrough(self, spool_dir):
+        sp = spool(spool_dir)
+        assert spool(sp) is sp
+
+    def test_ignores_foreign_files(self, spool_dir):
+        with open(os.path.join(spool_dir, "notes.txt"), "w") as fh:
+            fh.write("not das data")
+        with open(os.path.join(spool_dir, "junk.h5"), "wb") as fh:
+            fh.write(b"not hdf5 at all")
+        assert len(spool(spool_dir).update()) == 4
+
+
+class TestMemorySpoolAndMerge:
+    def test_memory_spool_select(self):
+        p = synthetic_patch(duration=30, fs=100.0, n_ch=8)
+        sp = MemorySpool([p])
+        t = p.coords["time"]
+        sub = sp.select(time=(t[100], t[400]))
+        assert sub[0].shape[0] == 301
+
+    def test_merge_overlapping_patches_dedupes(self):
+        p = synthetic_patch(duration=30, fs=100.0, n_ch=4)
+        t = p.coords["time"]
+        a = p.select(time=(t[0], t[1999]))
+        b = p.select(time=(t[1500], t[2999]))  # overlaps a by 500
+        merged = merge_patches([a, b])
+        assert len(merged) == 1
+        assert merged[0].shape[0] == 3000
+        assert np.allclose(merged[0].host_data(), p.host_data())
+
+    def test_chunk_segments(self):
+        p = synthetic_patch(duration=30, fs=100.0, n_ch=4)
+        segs = MemorySpool([p]).chunk(time=10.0)
+        assert len(segs) == 3
+        assert all(s.shape[0] == 1000 for s in segs)

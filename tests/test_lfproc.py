@@ -1,0 +1,263 @@
+"""LFProc engine: naming contracts, parameters, scheduling invariants,
+edge calibration, seam-freeness, resume idempotency (SURVEY.md §4)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from tpudas import spool
+from tpudas.proc.edge import get_edge_effect_time
+from tpudas.proc.lfproc import LFProc, schedule_windows
+from tpudas.proc.memory import get_patch_time
+from tpudas.proc.naming import get_filename, get_timestr
+from tpudas.testing import lowfreq_truth, make_synthetic_spool
+
+FS = 100.0
+N_CH = 8
+FILE_SEC = 30.0
+N_FILES = 8  # 4 minutes of stream
+DT_OUT = 1.0  # output interval (s): corner 0.45 Hz
+
+
+@pytest.fixture(scope="module")
+def spool_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("raw")
+    make_synthetic_spool(
+        d, n_files=N_FILES, file_duration=FILE_SEC, fs=FS, n_ch=N_CH,
+        noise=0.01,
+    )
+    return str(d)
+
+
+def run_lfproc(src, out_dir, t1, t2, patch_size=60, buff=10):
+    lfp = LFProc(spool(src).sort("time").update())
+    lfp.update_processing_parameter(
+        output_sample_interval=DT_OUT,
+        process_patch_size=patch_size,
+        edge_buff_size=buff,
+    )
+    lfp.set_output_folder(str(out_dir), delete_existing=True)
+    lfp.process_time_range(np.datetime64(t1), np.datetime64(t2))
+    return lfp
+
+
+class TestNaming:
+    def test_timestr_exact_contract(self):
+        # str(dt64[ms])[:21] with ":" removed (lf_das.py:23-26): one
+        # sub-second digit survives
+        t = np.datetime64("2023-01-02T03:04:05.123", "ms")
+        assert get_timestr(t) == "2023-01-02T030405.1"
+
+    def test_filename_exact_contract(self):
+        t0 = np.datetime64("2023-01-02T03:04:05.123")
+        t1 = np.datetime64("2023-01-02T03:05:45.900")
+        assert (
+            get_filename(t0, t1)
+            == "LFDAS_2023-01-02T030405.1_2023-01-02T030545.9.h5"
+        )
+
+
+class TestMemoryModel:
+    def test_closed_form(self):
+        # 10 GB, 1 kHz, 1000 ch → 48 MB/s → ≈208 s (lf_das.py:98-106)
+        t = get_patch_time(10000, 1000, 1000)
+        assert abs(t - 10000 / 48.0) < 1e-9
+
+
+class TestParameters:
+    def test_defaults_and_frozen_view(self):
+        lfp = LFProc()
+        p = lfp.parameters
+        assert p["output_sample_interval"] == 1.0
+        assert p["process_patch_size"] == 100
+        assert p["edge_buff_size"] == 10
+        assert "data_gap_tolorance" in p  # reference-compat key
+        with pytest.raises(TypeError):
+            p["edge_buff_size"] = 3  # type: ignore[index]
+
+    def test_unknown_key_warns_not_raises(self, capsys):
+        lfp = LFProc()
+        lfp.update_processing_parameter(bogus_key=1)
+        assert "bogus_key is not default parameter key" in capsys.readouterr().out
+        assert "bogus_key" not in lfp.parameters
+
+    def test_output_folder_required(self):
+        with pytest.raises(Exception, match="output folder"):
+            LFProc().process_time_range(
+                np.datetime64("2023-01-01"), np.datetime64("2023-01-02")
+            )
+
+
+class TestSchedule:
+    def test_overlap_save_invariants(self):
+        n, ps, buff = 500, 100, 10
+        wins = schedule_windows(n, ps, buff)
+        # emitted interiors tile [buff, ...) contiguously, no overlap
+        assert wins[0][2] == buff
+        for (pl, ph, el, eh), (nl, nh, nel, neh) in zip(wins, wins[1:]):
+            assert nel == eh  # seamless
+            assert nl == ph - 2 * buff  # window overlap = 2*buff
+        # selections never exceed the grid
+        assert all(0 <= a < b < n for a, b, _, _ in wins)
+
+    def test_small_grid_shrinks_patch(self):
+        wins = schedule_windows(50, 100, 5)
+        assert wins[0][1] == 49
+
+    def test_rejects_buffer_dominated_window(self):
+        with pytest.raises(ValueError, match="edge_buff_size"):
+            schedule_windows(500, 20, 10)
+
+
+class TestEdgeCalibration:
+    def test_probe_measures_fft_filter(self):
+        edge = get_edge_effect_time(1 / FS, 60.0, tol=1e-3, freq=1 / DT_OUT)
+        assert 0.5 < edge < 30.0
+
+    def test_smaller_tol_wider_edge(self):
+        e1 = get_edge_effect_time(1 / FS, 60.0, tol=1e-2, freq=1 / DT_OUT)
+        e2 = get_edge_effect_time(1 / FS, 60.0, tol=1e-4, freq=1 / DT_OUT)
+        assert e2 >= e1
+
+    def test_chunk_too_small_raises(self):
+        with pytest.raises(ValueError, match="edge_t value"):
+            get_edge_effect_time(1 / FS, 4.0, tol=1e-9, freq=1.0)
+
+
+class TestEndToEnd:
+    def test_output_files_and_naming(self, spool_dir, tmp_path):
+        out = tmp_path / "results"
+        run_lfproc(
+            spool_dir, out, "2023-03-22T00:00:00", "2023-03-22T00:04:00"
+        )
+        files = sorted(os.listdir(out))
+        assert files and all(f.startswith("LFDAS_") and f.endswith(".h5") for f in files)
+
+    def test_output_is_contiguous_and_decimated(self, spool_dir, tmp_path):
+        out = tmp_path / "results"
+        run_lfproc(
+            spool_dir, out, "2023-03-22T00:00:00", "2023-03-22T00:04:00"
+        )
+        merged = spool(str(out)).update().chunk(time=None)
+        assert len(merged) == 1
+        p = merged[0]
+        assert p.attrs["time_step"] == np.timedelta64(1, "s")
+        steps = np.diff(p.coords["time"].astype(np.int64))
+        assert np.all(steps == 1_000_000_000)
+
+    def test_recovers_lowfreq_signal(self, spool_dir, tmp_path):
+        out = tmp_path / "results"
+        run_lfproc(
+            spool_dir, out, "2023-03-22T00:00:00", "2023-03-22T00:04:00"
+        )
+        p = spool(str(out)).update().chunk(time=None)[0]
+        data = p.host_data()
+        truth_times = p.coords["time"]
+        # rebuild the known LF component with the stream phase origin
+        origin = np.datetime64("2023-03-22T00:00:00", "ns")
+        t_sec = (truth_times - origin).astype(np.int64) / 1e9
+        dists = p.coords["distance"]
+        amp = 1.0 + dists / (dists.max() + 1.0)
+        truth = np.sin(2 * np.pi * 0.05 * t_sec)[:, None] * amp[None, :]
+        interior = slice(15, -15)
+        err = np.abs(data[interior] - truth[interior])
+        assert err.max() < 0.05
+
+    def test_seam_freeness(self, spool_dir, tmp_path):
+        """Chunked overlap-save output must equal single-shot whole-range
+        processing — the invariant the scheduler exists to preserve."""
+        chunked_dir = tmp_path / "chunked"
+        single_dir = tmp_path / "single"
+        t1, t2 = "2023-03-22T00:00:00", "2023-03-22T00:04:00"
+        run_lfproc(spool_dir, chunked_dir, t1, t2, patch_size=60, buff=10)
+        run_lfproc(spool_dir, single_dir, t1, t2, patch_size=239, buff=10)
+        a = spool(str(chunked_dir)).update().chunk(time=None)[0]
+        b = spool(str(single_dir)).update().chunk(time=None)[0]
+        ta, tb = a.coords["time"], b.coords["time"]
+        lo, hi = max(ta[0], tb[0]), min(ta[-1], tb[-1])
+        asel = a.select(time=(lo, hi))
+        bsel = b.select(time=(lo, hi))
+        assert asel.shape == bsel.shape
+        scale = np.abs(bsel.host_data()).max()
+        assert np.abs(asel.host_data() - bsel.host_data()).max() < 5e-3 * scale
+
+    def test_resume_with_overlap_is_seamless(self, spool_dir, tmp_path):
+        """Kill-and-resume (the edge-loop contract, §3.2) must produce
+        the same contiguous output as one uninterrupted run."""
+        out_resumed = tmp_path / "resumed"
+        out_full = tmp_path / "full"
+        t1, tmid, t2 = (
+            "2023-03-22T00:00:00",
+            "2023-03-22T00:02:00",
+            "2023-03-22T00:04:00",
+        )
+        buff = 10
+        # phase 1: process the first half, then "crash"
+        lfp = run_lfproc(spool_dir, out_resumed, t1, tmid, buff=buff)
+        # phase 2: fresh engine resumes from output state with rewind
+        lfp2 = LFProc(spool(spool_dir).sort("time").update())
+        lfp2.update_processing_parameter(
+            output_sample_interval=DT_OUT,
+            process_patch_size=60,
+            edge_buff_size=buff,
+        )
+        lfp2.set_output_folder(str(out_resumed), delete_existing=False)
+        t_last = lfp2.get_last_processed_time()
+        rewind = int((buff - 1) * DT_OUT)
+        lfp2.process_time_range(
+            t_last - np.timedelta64(rewind, "s"), np.datetime64(t2)
+        )
+        run_lfproc(spool_dir, out_full, t1, t2)
+        a = spool(str(out_resumed)).update().chunk(time=None)
+        assert len(a) == 1  # no seam, no gap
+        b = spool(str(out_full)).update().chunk(time=None)[0]
+        ta, tb = a[0].coords["time"], b.coords["time"]
+        lo, hi = max(ta[0], tb[0]), min(ta[-1], tb[-1])
+        asel = a[0].select(time=(lo, hi))
+        bsel = b.select(time=(lo, hi))
+        scale = np.abs(bsel.host_data()).max()
+        assert np.abs(asel.host_data() - bsel.host_data()).max() < 5e-3 * scale
+
+    def test_gap_skip_mode(self, tmp_path):
+        d = tmp_path / "gappy"
+        make_synthetic_spool(
+            d, n_files=2, file_duration=30.0, fs=FS, n_ch=4, noise=0.0
+        )
+        make_synthetic_spool(
+            d, n_files=2, file_duration=30.0, fs=FS, n_ch=4, noise=0.0,
+            start="2023-03-22T00:02:00",
+        )
+        lfp = LFProc(spool(str(d)).sort("time").update())
+        lfp.update_processing_parameter(
+            output_sample_interval=DT_OUT,
+            process_patch_size=40,
+            edge_buff_size=5,
+            on_gap="skip",
+        )
+        lfp.set_output_folder(str(tmp_path / "out"), delete_existing=True)
+        lfp.process_time_range(
+            np.datetime64("2023-03-22T00:00:00"),
+            np.datetime64("2023-03-22T00:03:00"),
+        )
+        merged = spool(str(tmp_path / "out")).update().chunk(time=None)
+        assert len(merged) >= 1  # produced output on both sides of the gap
+
+    def test_gap_raise_mode(self, tmp_path):
+        d = tmp_path / "gappy2"
+        make_synthetic_spool(d, n_files=1, file_duration=30.0, fs=FS, n_ch=4)
+        make_synthetic_spool(
+            d, n_files=1, file_duration=30.0, fs=FS, n_ch=4,
+            start="2023-03-22T00:02:00",
+        )
+        lfp = LFProc(spool(str(d)).sort("time").update())
+        lfp.update_processing_parameter(
+            output_sample_interval=DT_OUT, process_patch_size=40,
+            edge_buff_size=5,
+        )
+        lfp.set_output_folder(str(tmp_path / "out2"), delete_existing=True)
+        with pytest.raises(Exception, match="Gap in data exists"):
+            lfp.process_time_range(
+                np.datetime64("2023-03-22T00:00:00"),
+                np.datetime64("2023-03-22T00:03:00"),
+            )

@@ -1,0 +1,35 @@
+"""
+tpudas — TPU-native low-frequency & real-time DAS processing.
+
+A brand-new JAX/XLA framework with the capabilities of
+DASDAE/low-freq-real-time (see /root/repo/SURVEY.md): a Patch/Spool data
+layer for (time x distance) strain-rate arrays, zero-phase low-pass +
+decimation and rolling-mean kernels executing on TPU, chunk-wise
+overlap-save streaming with self-calibrating edge buffers, and crash-only
+resume from the output spool.
+
+Public API mirrors the DASCore surface consumed by the reference
+notebooks (SURVEY.md §2.3) so they run unchanged via the `dascore`
+compat shim.
+"""
+
+from tpudas.core.patch import Patch
+from tpudas.core.timeutils import to_datetime64, to_timedelta64
+from tpudas.core.mapping import FrozenDict
+from tpudas.io.spool import spool, BaseSpool, MemorySpool, DirectorySpool
+from tpudas.core import units
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Patch",
+    "spool",
+    "BaseSpool",
+    "MemorySpool",
+    "DirectorySpool",
+    "to_datetime64",
+    "to_timedelta64",
+    "FrozenDict",
+    "units",
+    "__version__",
+]

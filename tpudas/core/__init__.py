@@ -1,0 +1,1 @@
+"""Core data structures: Patch, coordinates, attrs, time utilities, units."""

@@ -1,0 +1,21 @@
+"""Storage/IO layer: dasdae-format HDF5, directory indexing, spools.
+
+The tpudas equivalent of SURVEY.md L1: format-dispatched read/write
+(``patch.io.write(path, "dasdae")`` — lf_das.py:232) and directory spool
+indexing (``dc.spool(path).update()`` — low_pass_dascore.ipynb:78).
+IO is host-side by design — on TPU the idiomatic split keeps HDF5 on
+the CPU and feeds the device via async transfers.
+"""
+
+from tpudas.io.spool import spool, BaseSpool, MemorySpool, DirectorySpool
+from tpudas.io.registry import write_patch, read_file, scan_file
+
+__all__ = [
+    "spool",
+    "BaseSpool",
+    "MemorySpool",
+    "DirectorySpool",
+    "write_patch",
+    "read_file",
+    "scan_file",
+]

@@ -1,0 +1,167 @@
+"""Incremental directory index for spools.
+
+``dc.spool(path).update()`` must cheaply pick up new interrogator files
+every polling round (low_pass_dascore_edge.ipynb:201), so the index is
+incremental: files are re-scanned only when (mtime, size) changes, and
+the index persists to ``.tpudas_index.json`` inside the directory ("on
+first run, it will index the patches and subsequently update the index
+file for future uses" — the reference notebooks' contract). A file still
+being written by the interrogator simply shows a changing (mtime, size)
+and is re-scanned next round — the cadence clamp in the edge loop
+(low_pass_dascore_edge.ipynb:165-173) bounds that race as in the
+reference.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+import numpy as np
+import pandas as pd
+
+INDEX_FILENAME = ".tpudas_index.json"
+_SUFFIXES = (".h5", ".hdf5")
+
+_COLUMNS = [
+    "path",
+    "mtime",
+    "size",
+    "time_min",
+    "time_max",
+    "time_step",
+    "distance_min",
+    "distance_max",
+    "ntime",
+    "ndistance",
+    "format",
+    "dims",
+]
+
+
+def _record_to_json(rec: dict) -> dict:
+    out = {}
+    for k, v in rec.items():
+        if isinstance(v, np.datetime64):
+            out[k] = {"__dt64__": int(v.astype("datetime64[ns]").astype(np.int64))}
+        elif isinstance(v, np.timedelta64):
+            out[k] = {"__td64__": int(v.astype("timedelta64[ns]").astype(np.int64))}
+        elif isinstance(v, (np.integer,)):
+            out[k] = int(v)
+        elif isinstance(v, (np.floating,)):
+            out[k] = float(v)
+        elif isinstance(v, tuple):
+            out[k] = list(v)
+        else:
+            out[k] = v
+    return out
+
+
+def _record_from_json(rec: dict) -> dict:
+    out = {}
+    for k, v in rec.items():
+        if isinstance(v, dict) and "__dt64__" in v:
+            out[k] = np.datetime64(int(v["__dt64__"]), "ns")
+        elif isinstance(v, dict) and "__td64__" in v:
+            out[k] = np.timedelta64(int(v["__td64__"]), "ns")
+        else:
+            out[k] = v
+    return out
+
+
+class DirectoryIndex:
+    """Metadata index of all readable DAS files in one directory."""
+
+    def __init__(self, directory):
+        self.directory = os.path.abspath(str(directory))
+        self._records: dict[str, dict] = {}
+        self._loaded_cache = False
+
+    # cache persistence ------------------------------------------------
+    @property
+    def cache_path(self) -> str:
+        return os.path.join(self.directory, INDEX_FILENAME)
+
+    def _load_cache(self):
+        self._loaded_cache = True
+        try:
+            with open(self.cache_path) as fh:
+                raw = json.load(fh)
+            self._records = {
+                k: _record_from_json(v) for k, v in raw.get("files", {}).items()
+            }
+        except (OSError, ValueError, KeyError):
+            self._records = {}
+
+    def _save_cache(self):
+        payload = {
+            "version": 1,
+            "files": {k: _record_to_json(v) for k, v in self._records.items()},
+        }
+        try:
+            fd, tmp = tempfile.mkstemp(
+                dir=self.directory, prefix=".tpudas_index.", suffix=".tmp"
+            )
+            with os.fdopen(fd, "w") as fh:
+                json.dump(payload, fh)
+            os.replace(tmp, self.cache_path)
+        except OSError:
+            pass  # read-only data dir: keep the index in memory only
+
+    # scanning ---------------------------------------------------------
+    def update(self) -> "DirectoryIndex":
+        """Incrementally rescan the directory; returns self."""
+        from tpudas.io.registry import scan_file
+
+        if not self._loaded_cache:
+            self._load_cache()
+        if not os.path.isdir(self.directory):
+            raise FileNotFoundError(f"no such directory: {self.directory}")
+        seen = set()
+        changed = False
+        for name in sorted(os.listdir(self.directory)):
+            if not name.lower().endswith(_SUFFIXES):
+                continue
+            path = os.path.join(self.directory, name)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            seen.add(name)
+            rec = self._records.get(name)
+            if rec is not None and rec.get("mtime") == st.st_mtime and rec.get(
+                "size"
+            ) == st.st_size:
+                continue
+            try:
+                info = scan_file(path)[0]
+            except (OSError, ValueError):
+                continue  # unreadable / foreign / partially-written file
+            info["mtime"] = st.st_mtime
+            info["size"] = st.st_size
+            info.pop("shape", None)
+            self._records[name] = info
+            changed = True
+        missing = set(self._records) - seen
+        for name in missing:
+            del self._records[name]
+            changed = True
+        if changed:
+            self._save_cache()
+        return self
+
+    def ensure(self) -> "DirectoryIndex":
+        """Index lazily if never scanned (spool used without .update())."""
+        if not self._records:
+            self.update()
+        return self
+
+    def to_dataframe(self) -> pd.DataFrame:
+        if not self._records:
+            return pd.DataFrame(columns=_COLUMNS)
+        df = pd.DataFrame(list(self._records.values()))
+        for col in _COLUMNS:
+            if col not in df.columns:
+                df[col] = None
+        return df

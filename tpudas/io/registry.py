@@ -1,0 +1,43 @@
+"""Format dispatch for patch IO.
+
+Mirrors the reference's format-dispatched write call
+(``patch.io.write(path, "dasdae")`` — lf_das.py:232). New formats
+register a (read, write, scan) triple; reads sniff the format when not
+given.
+"""
+
+from __future__ import annotations
+
+from tpudas.io import dasdae
+
+_FORMATS = {
+    "dasdae": (dasdae.read_dasdae, dasdae.write_dasdae, dasdae.scan_dasdae),
+}
+
+
+def register_format(name, read, write, scan):
+    _FORMATS[name.lower()] = (read, write, scan)
+
+
+def _resolve(name):
+    try:
+        return _FORMATS[name.lower()]
+    except KeyError:
+        raise ValueError(
+            f"unknown IO format {name!r}; known: {sorted(_FORMATS)}"
+        ) from None
+
+
+def write_patch(patch, path, format="dasdae", **kwargs):
+    _, write, _ = _resolve(format)
+    return write(patch, path, **kwargs)
+
+
+def read_file(path, format="dasdae", **kwargs):
+    read, _, _ = _resolve(format)
+    return read(path, **kwargs)
+
+
+def scan_file(path, format="dasdae"):
+    _, _, scan = _resolve(format)
+    return scan(path)

@@ -1,0 +1,344 @@
+"""Spools: lazy, indexable views over collections of patches.
+
+The tpudas equivalent of the DASCore Spool surface the reference
+consumes (SURVEY.md §2.3): ``spool(...)`` dispatch, ``update``, ``sort``,
+``select``, ``chunk(time=None)`` merge with gap detection,
+``get_contents``, indexing/iteration. Selection is recorded lazily and
+applied at materialization, so a ``DirectorySpool`` window read
+(``spool.select(time=...)`` inside the overlap-save loop, lf_das.py:236)
+touches only the overlapping files and only the needed byte ranges.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pandas as pd
+
+from tpudas.core.patch import Patch
+from tpudas.core.timeutils import to_datetime64
+from tpudas.io.index import DirectoryIndex
+
+__all__ = ["spool", "BaseSpool", "MemorySpool", "DirectorySpool", "merge_patches"]
+
+
+def spool(obj):
+    """Create a spool from a path, a Patch, a list of patches, or pass
+    an existing spool through (``dc.spool(...)`` — lf_das.py:215,239)."""
+    if isinstance(obj, BaseSpool):
+        return obj
+    if isinstance(obj, Patch):
+        return MemorySpool([obj])
+    if isinstance(obj, (list, tuple)):
+        return MemorySpool(list(obj))
+    if isinstance(obj, (str, os.PathLike)):
+        path = str(obj)
+        if os.path.isdir(path):
+            return DirectorySpool(path)
+        if os.path.isfile(path):
+            from tpudas.io.registry import read_file
+
+            return MemorySpool(read_file(path))
+        raise FileNotFoundError(f"no such file or directory: {path}")
+    raise TypeError(f"cannot build a spool from {type(obj)!r}")
+
+
+def _normalize_time_bounds(bounds):
+    if bounds is None:
+        return None
+    lo, hi = bounds
+    return (
+        None if lo is None else to_datetime64(lo),
+        None if hi is None else to_datetime64(hi),
+    )
+
+
+def merge_patches(patches, tolerance=1.5):
+    """Merge time-sorted patches into maximal contiguous groups.
+
+    Adjacent patches are contiguous when the start of the next is within
+    ``tolerance * time_step`` of one step past the end of the previous.
+    Exact overlaps (an integer number of steps, e.g. re-written resume
+    windows) are trimmed from the incoming patch; true gaps split the
+    result into multiple patches — the caller (``_check_merge``
+    semantics, lf_das.py:16-20) decides whether that is an error.
+    """
+    if not patches:
+        return []
+    patches = sorted(patches, key=lambda p: p.attrs["time_min"])
+    groups = [[patches[0]]]
+    for p in patches[1:]:
+        prev = groups[-1][-1]
+        step = prev.attrs.get("time_step")
+        step_ns = (
+            int(step.astype("timedelta64[ns]").astype(np.int64))
+            if step is not None
+            else 0
+        )
+        gap_ns = int(
+            (
+                p.attrs["time_min"].astype("datetime64[ns]")
+                - prev.attrs["time_max"].astype("datetime64[ns]")
+            ).astype(np.int64)
+        )
+        if step_ns > 0 and gap_ns <= tolerance * step_ns:
+            groups[-1].append(p)
+        else:
+            groups.append([p])
+    out = []
+    for group in groups:
+        if len(group) == 1:
+            out.append(group[0])
+            continue
+        datas = []
+        times = []
+        prev_end = None
+        for p in group:
+            data = p.host_data()
+            taxis = p.coords["time"]
+            if prev_end is not None and taxis.size and taxis[0] <= prev_end:
+                # overlap: drop duplicated leading samples
+                keep = taxis > prev_end
+                start = int(np.argmax(keep)) if keep.any() else taxis.size
+                data = data[start:]
+                taxis = taxis[start:]
+            if taxis.size == 0:
+                continue
+            datas.append(data)
+            times.append(taxis)
+            prev_end = taxis[-1]
+        first = group[0]
+        ax = first.axis_of("time")
+        if ax != 0:
+            datas = [np.moveaxis(d, ax, 0) for d in datas]
+        merged = np.concatenate(datas, axis=0)
+        if ax != 0:
+            merged = np.moveaxis(merged, 0, ax)
+        coords = dict(first.coords)
+        coords["time"] = np.concatenate(times)
+        out.append(
+            Patch(
+                data=merged,
+                coords=coords,
+                dims=first.dims,
+                attrs=first.attrs.to_dict(),
+            )
+        )
+    return out
+
+
+class BaseSpool:
+    """Common spool behavior; subclasses implement materialization."""
+
+    # -- abstract surface ---------------------------------------------
+    def _materialize(self) -> list:
+        raise NotImplementedError
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def update(self):
+        return self
+
+    def sort(self, key="time"):
+        return self
+
+    # -- shared behavior ----------------------------------------------
+    def __getitem__(self, item):
+        patches = self._materialize()
+        return patches[item]
+
+    def __iter__(self):
+        return iter(self._materialize())
+
+    def select(self, time=None, distance=None):
+        raise NotImplementedError
+
+    def chunk(self, time="__required__", overlap=None, tolerance=1.5):
+        """``chunk(time=None)`` merges contiguous patches along time;
+        ``chunk(time=seconds)`` merges then re-splits into fixed-length
+        segments (an extension the reference leaves to DASCore)."""
+        if time == "__required__":
+            raise TypeError("chunk() requires the time keyword, e.g. time=None")
+        merged = merge_patches(self._materialize(), tolerance=tolerance)
+        if time is None:
+            return MemorySpool(merged)
+        seg_sec = float(time)
+        out = []
+        for p in merged:
+            taxis = p.coords["time"]
+            if taxis.size == 0:
+                continue
+            step = p.attrs.get("time_step")
+            if step is None:
+                raise ValueError(
+                    "chunk(time=<seconds>) requires a patch with a known "
+                    "time_step (single-sample or step-less patches cannot "
+                    "be segmented)"
+                )
+            step_s = step.astype("timedelta64[ns]").astype(np.int64) / 1e9
+            seg_n = max(int(round(seg_sec / step_s)), 1)
+            ax = p.axis_of("time")
+            host = p.host_data()
+            for start in range(0, taxis.size, seg_n):
+                sl = (slice(None),) * ax + (slice(start, start + seg_n),)
+                out.append(
+                    Patch(
+                        data=host[sl],
+                        coords={**p.coords, "time": taxis[start : start + seg_n]},
+                        dims=p.dims,
+                        attrs=p.attrs.to_dict(),
+                    )
+                )
+        return MemorySpool(out)
+
+    def get_contents(self) -> pd.DataFrame:
+        rows = []
+        for p in self._materialize():
+            a = p.attrs
+            rows.append(
+                {
+                    "time_min": a.get("time_min"),
+                    "time_max": a.get("time_max"),
+                    "time_step": a.get("time_step"),
+                    "distance_min": a.get("distance_min"),
+                    "distance_max": a.get("distance_max"),
+                    "ntime": len(p.coords.get("time", ())),
+                    "ndistance": len(p.coords.get("distance", ())),
+                }
+            )
+        return pd.DataFrame(rows)
+
+
+class MemorySpool(BaseSpool):
+    """A spool over in-memory patches."""
+
+    def __init__(self, patches):
+        self._patches = list(patches)
+
+    def _materialize(self):
+        return self._patches
+
+    def __len__(self):
+        return len(self._patches)
+
+    def sort(self, key="time"):
+        return MemorySpool(
+            sorted(self._patches, key=lambda p: p.attrs[f"{key}_min"])
+        )
+
+    def select(self, time=None, distance=None):
+        time = _normalize_time_bounds(time)
+        out = []
+        for p in self._patches:
+            q = p.select(time=time, distance=distance)
+            if q.coords["time"].size and (
+                "distance" not in q.dims or q.coords["distance"].size
+            ):
+                out.append(q)
+        return MemorySpool(out)
+
+
+class DirectorySpool(BaseSpool):
+    """A lazy spool over an indexed directory of DAS files.
+
+    Selection criteria are recorded and pushed down into the file reads
+    (range-sliced HDF5 access), so materializing a processing window
+    reads only the bytes it needs.
+    """
+
+    _index_cache: dict[str, DirectoryIndex] = {}
+
+    def __init__(self, directory, _index=None, _time=None, _distance=None,
+                 _sort_key="time"):
+        self.directory = os.path.abspath(str(directory))
+        if _index is not None:
+            self._index = _index
+        else:
+            # share one index per directory per process: the edge loop
+            # re-creates spool(path).update() every round
+            self._index = DirectorySpool._index_cache.setdefault(
+                self.directory, DirectoryIndex(self.directory)
+            )
+        self._time = _time
+        self._distance = _distance
+        self._sort_key = _sort_key
+
+    def _clone(self, **kw):
+        args = {
+            "_index": self._index,
+            "_time": self._time,
+            "_distance": self._distance,
+            "_sort_key": self._sort_key,
+        }
+        args.update(kw)
+        return DirectorySpool(self.directory, **args)
+
+    def update(self):
+        """Re-scan the directory for new/changed files (incremental)."""
+        self._index.update()
+        return self._clone()
+
+    def sort(self, key="time"):
+        return self._clone(_sort_key=key)
+
+    def select(self, time=None, distance=None):
+        return self._clone(
+            _time=_normalize_time_bounds(time) if time is not None else self._time,
+            _distance=distance if distance is not None else self._distance,
+        )
+
+    # index-level filtering -------------------------------------------
+    def _frame(self) -> pd.DataFrame:
+        self._index.ensure()
+        df = self._index.to_dataframe()
+        if df.empty:
+            return df
+        if self._sort_key == "time":
+            df = df.sort_values("time_min", kind="stable")
+        if self._time is not None:
+            lo, hi = self._time
+            if lo is not None:
+                df = df[df["time_max"].to_numpy() >= lo]
+            if hi is not None:
+                df = df[df["time_min"].to_numpy() <= hi]
+        if self._distance is not None:
+            lo, hi = self._distance
+            if lo is not None:
+                df = df[df["distance_max"].astype(float) >= lo]
+            if hi is not None:
+                df = df[df["distance_min"].astype(float) <= hi]
+        return df.reset_index(drop=True)
+
+    def __len__(self):
+        return len(self._frame())
+
+    def _read_row(self, row) -> Patch:
+        from tpudas.io.registry import read_file
+
+        patches = read_file(
+            row["path"],
+            format=row.get("format", "dasdae"),
+            time=self._time,
+            distance=self._distance,
+        )
+        return patches[0]
+
+    def _materialize(self):
+        return [self._read_row(row) for _, row in self._frame().iterrows()]
+
+    def __getitem__(self, item):
+        df = self._frame()
+        n = len(df)
+        if isinstance(item, (int, np.integer)):
+            idx = int(item)
+            if idx < 0:
+                idx += n
+            if not 0 <= idx < n:
+                raise IndexError(f"spool index {item} out of range ({n} patches)")
+            return self._read_row(df.iloc[idx])
+        return [self._read_row(row) for _, row in df.iloc[item].iterrows()]
+
+    def get_contents(self) -> pd.DataFrame:
+        return self._frame()

@@ -1,0 +1,24 @@
+"""TPU compute kernels for Patch operations.
+
+Every kernel has two engines:
+
+- ``"jax"`` (default): jitted XLA/TPU path — static shapes, fused, and
+  vmap/shard_map-friendly. This is the production path.
+- ``"numpy"``: float64 host reference implementation used for parity
+  testing and for the reference notebooks' explicit ``engine="numpy"``
+  call sites.
+"""
+
+from tpudas.ops.filter import patch_pass_filter, fft_lowpass_response
+from tpudas.ops.resample import patch_interpolate, interp_indices_weights
+from tpudas.ops.rolling import PatchRoller
+from tpudas.ops.median import patch_median_filter
+
+__all__ = [
+    "patch_pass_filter",
+    "fft_lowpass_response",
+    "patch_interpolate",
+    "interp_indices_weights",
+    "PatchRoller",
+    "patch_median_filter",
+]

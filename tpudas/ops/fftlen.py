@@ -1,0 +1,39 @@
+"""TPU-friendly FFT sizing.
+
+XLA's TPU FFT handles 2/3/5-smooth lengths with a real FFT algorithm,
+but falls back to a materialized DFT *matmul* for lengths with larger
+prime factors — an O(n^2) memory blow-up (observed: a 182952-point FFT
+attempting a 134 GB [n, n] allocation, because scipy's ``next_fast_len``
+admits factors 7 and 11). All tpudas kernels therefore pad to the next
+5-smooth length: bounded ~6% typical padding overhead, and the
+frequency-domain response is length-aware so results are unchanged.
+"""
+
+from __future__ import annotations
+
+__all__ = ["next_tpu_fft_len"]
+
+_cache: dict[int, int] = {}
+
+
+def _is_5smooth(n: int) -> bool:
+    for p in (2, 3, 5):
+        while n % p == 0:
+            n //= p
+    return n == 1
+
+
+def next_tpu_fft_len(n: int) -> int:
+    """Smallest 5-smooth (2^a * 3^b * 5^c) integer >= n."""
+    n = int(n)
+    if n <= 1:
+        return 1
+    hit = _cache.get(n)
+    if hit is not None:
+        return hit
+    # search upward from n; 5-smooth numbers are dense enough (<6% gaps)
+    m = n
+    while not _is_5smooth(m):
+        m += 1
+    _cache[n] = m
+    return m

@@ -1,0 +1,193 @@
+"""Rolling-window reductions with pandas-compatible semantics.
+
+The reference computes mean-decimation via
+``patch.rolling(time=w, step=s, engine="numpy").mean()``
+(rolling_mean_dascore.ipynb:148). Semantics (DASCore mimics pandas
+``rolling(window, step=step)``):
+
+- output positions are input indices ``p = 0, s, 2s, ...`` (so the
+  output time coord is ``time[::s]``),
+- the window at position ``p`` is the trailing ``[p-w+1, p]``,
+- positions with ``p < w-1`` (incomplete window) are NaN — the warm-up
+  prefix downstream strips with ``dropna("time")``.
+
+TPU engine: ``lax.reduce_window`` (pairwise tree reduction — accurate in
+f32, fuses, maps to the VPU) on the alignment-shifted array, NaN prefix
+concatenated. Host engine: float64 cumsum / stride tricks.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpudas.core import units as _units
+
+__all__ = ["PatchRoller", "rolling_reduce"]
+
+
+def _window_step_samples(window_sec, step_sec, d_sec):
+    w = int(round(window_sec / d_sec))
+    s = int(round(step_sec / d_sec)) if step_sec is not None else 1
+    if w < 1:
+        raise ValueError(f"window shorter than one sample ({window_sec} s)")
+    if s < 1:
+        raise ValueError(f"step shorter than one sample ({step_sec} s)")
+    return w, s
+
+
+@functools.partial(jax.jit, static_argnames=("w", "s", "op"))
+def _reduce_window_kernel(data, w, s, op):
+    """data: (T, C). Valid trailing windows at stride s, pandas-aligned.
+
+    Returns the full output (including NaN warm-up rows).
+    """
+    n = data.shape[0]
+    n_out = (n - 1) // s + 1  # positions 0, s, 2s, ... < n
+    i0 = -(-(w - 1) // s)  # ceil((w-1)/s): first complete-window output
+    if i0 >= n_out:  # static: no window ever completes
+        return jnp.full((n_out,) + data.shape[1:], jnp.nan, data.dtype)
+    j0 = i0 * s - w + 1  # input start so valid windows land on positions
+    x = data[j0:]
+    if op == "mean" or op == "sum":
+        init, fn = 0.0, jax.lax.add
+    elif op == "max":
+        init, fn = -jnp.inf, jax.lax.max
+    elif op == "min":
+        init, fn = jnp.inf, jax.lax.min
+    else:
+        raise ValueError(op)
+    red = jax.lax.reduce_window(
+        x,
+        jnp.asarray(init, data.dtype),
+        fn,
+        window_dimensions=(w,) + (1,) * (data.ndim - 1),
+        window_strides=(s,) + (1,) * (data.ndim - 1),
+        padding="valid",
+    )
+    if op == "mean":
+        red = red / w
+    nan_rows = jnp.full((i0,) + data.shape[1:], jnp.nan, data.dtype)
+    return jnp.concatenate([nan_rows, red], axis=0)
+
+
+def _host_rolling(data, w, s, op):
+    """float64 host reference (pandas semantics, no pandas dependency)."""
+    n = data.shape[0]
+    positions = np.arange(0, n, s)
+    out = np.full((len(positions),) + data.shape[1:], np.nan, dtype=np.float64)
+    x = data.astype(np.float64)
+    if op in ("mean", "sum"):
+        c = np.cumsum(x, axis=0)
+        zero = np.zeros((1,) + x.shape[1:])
+        c = np.concatenate([zero, c], axis=0)  # c[k] = sum of first k
+        valid = positions >= w - 1
+        pv = positions[valid]
+        ssum = c[pv + 1] - c[pv + 1 - w]
+        out[valid] = ssum / w if op == "mean" else ssum
+    else:
+        fn = np.max if op == "max" else np.min
+        for k, p in enumerate(positions):
+            if p >= w - 1:
+                out[k] = fn(x[p + 1 - w : p + 1], axis=0)
+    return out
+
+
+def rolling_reduce(data, w, s, op, axis=0, engine=None):
+    """Rolling reduction along ``axis`` with pandas alignment."""
+    if engine in ("numpy", "host"):
+        host = np.asarray(data)
+        moved = axis != 0
+        if moved:
+            host = np.moveaxis(host, axis, 0)
+        out = _host_rolling(host, w, s, op).astype(np.float64)
+        if moved:
+            out = np.moveaxis(out, 0, axis)
+        return out
+    arr = jnp.asarray(data)
+    if not jnp.issubdtype(arr.dtype, jnp.floating):
+        arr = arr.astype(jnp.float32)
+    moved = axis != 0
+    if moved:
+        arr = jnp.moveaxis(arr, axis, 0)
+    out = _reduce_window_kernel(arr, int(w), int(s), op)
+    if moved:
+        out = jnp.moveaxis(out, 0, axis)
+    return out
+
+
+class PatchRoller:
+    """Factory returned by ``patch.rolling(time=w, step=s, engine=...)``."""
+
+    def __init__(self, patch, step=None, engine=None, **kwargs):
+        if len(kwargs) != 1:
+            raise ValueError("rolling requires exactly one dim, e.g. time=1*s")
+        (dim, window), = kwargs.items()
+        self.patch = patch
+        self.dim = dim
+        self.engine = engine
+        d = patch.get_sample_step(dim)
+        if d is None or d <= 0:
+            raise ValueError(f"cannot infer sample step for dim {dim!r}")
+        self.window, self.step = _window_step_samples(
+            _units.get_seconds(window), _units.get_seconds(step), d
+        )
+
+    def _stepped_coords_attrs(self, p):
+        """Subsampled coords + attrs with the *_step refreshed to the
+        post-decimation step (stale steps would corrupt any downstream
+        Nyquist / window / contiguity computation)."""
+        from tpudas.core.attrs import derive_coord_attrs
+
+        coords = dict(p.coords)
+        coords[self.dim] = p.coords[self.dim][:: self.step]
+        attrs = p.attrs.to_dict()
+        attrs.update(derive_coord_attrs(coords, p.dims))
+        return coords, attrs
+
+    def _apply(self, op):
+        p = self.patch
+        ax = p.axis_of(self.dim)
+        out = rolling_reduce(
+            p.data, self.window, self.step, op, axis=ax, engine=self.engine
+        )
+        coords, attrs = self._stepped_coords_attrs(p)
+        return p.new(data=out, coords=coords, attrs=attrs)
+
+    def mean(self):
+        return self._apply("mean")
+
+    def sum(self):
+        return self._apply("sum")
+
+    def min(self):
+        return self._apply("min")
+
+    def max(self):
+        return self._apply("max")
+
+    def std(self):
+        """Population std via E[x^2] - E[x]^2 on the same windows."""
+        p = self.patch
+        ax = p.axis_of(self.dim)
+        m = rolling_reduce(
+            p.data, self.window, self.step, "mean", axis=ax, engine=self.engine
+        )
+        data = p.data
+        sq = (
+            np.asarray(data, np.float64) ** 2
+            if self.engine in ("numpy", "host")
+            else jnp.asarray(data) ** 2
+        )
+        m2 = rolling_reduce(
+            sq, self.window, self.step, "mean", axis=ax, engine=self.engine
+        )
+        xp = np if self.engine in ("numpy", "host") else jnp
+        var = xp.maximum(m2 - m**2, 0)
+        out = xp.sqrt(var)
+        coords, attrs = self._stepped_coords_attrs(p)
+        return p.new(data=out, coords=coords, attrs=attrs)

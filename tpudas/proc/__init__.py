@@ -1,0 +1,24 @@
+"""Processing-orchestration layer (SURVEY.md L3/L4).
+
+Public surface mirrors the reference's ``lf_das`` module
+(/root/reference/lf_das.py): the ``LFProc`` chunked overlap-save engine,
+the self-calibrating edge probe, the memory-model chunk sizer, file
+naming helpers, and the QC waterfall plot.
+"""
+
+from tpudas.proc.naming import get_timestr, get_filename
+from tpudas.proc.memory import get_patch_time
+from tpudas.proc.edge import down_sample_processing, get_edge_effect_time
+from tpudas.proc.lfproc import LFProc, check_merge
+from tpudas.viz.waterfall import waterfall_plot
+
+__all__ = [
+    "LFProc",
+    "check_merge",
+    "get_timestr",
+    "get_filename",
+    "get_patch_time",
+    "down_sample_processing",
+    "get_edge_effect_time",
+    "waterfall_plot",
+]

@@ -1,0 +1,34 @@
+"""Memory-model chunk sizing (reference lf_das.py:90-107).
+
+Sizes the overlap-save window so one in-flight chunk — raw window plus
+the processing working set — fits a memory budget:
+``bytes/sec = rate * n_ch * bytes_per_element * processing_factor *
+safety``. On TPU the same closed form applies with the budget set to
+usable HBM (about 14000 MB on a 16 GB v5e chip); the default
+``processing_factor`` stays at the reference's 5 (input + FFT spectrum
++ filtered + gather temps is comfortably under it in float32).
+"""
+
+from __future__ import annotations
+
+__all__ = ["get_patch_time"]
+
+
+def get_patch_time(
+    memory_size,
+    sampling_rate,
+    num_ch,
+    bytes_per_element=8,
+    processing_factor=5,
+    memory_safety_factor=1.2,
+):
+    """Chunk length (seconds) that fits ``memory_size`` MB of memory."""
+    mb_per_second = (
+        sampling_rate
+        * num_ch
+        * bytes_per_element
+        * processing_factor
+        * memory_safety_factor
+        / 1e6
+    )
+    return memory_size / mb_per_second

@@ -1,0 +1,117 @@
+"""Synthetic DAS data for tests, examples and benchmarks.
+
+The reference ships no fixtures beyond its impulse probe (SURVEY.md §4);
+tpudas provides a deterministic interrogator simulator: contiguous
+dasdae files of a (time x distance) strain-rate stream containing a
+known low-frequency component (recoverable after low-pass + decimate),
+high-frequency interference (must be rejected), and noise.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from tpudas.core.patch import Patch
+from tpudas.core.timeutils import to_datetime64
+from tpudas.io.registry import write_patch
+
+__all__ = ["synthetic_patch", "make_synthetic_spool", "lowfreq_truth"]
+
+DEFAULT_T0 = "2023-03-22T00:00:00"
+
+
+def _time_axis(t0, n, fs):
+    start = to_datetime64(t0).astype("datetime64[ns]")
+    step = np.timedelta64(int(round(1e9 / fs)), "ns")
+    return start + np.arange(n) * step
+
+
+def _signal(t_sec, dists, lf_freq, hf_freq, noise, rng):
+    """(T, C) strain-rate: channel-ramped LF sine + HF sine + noise."""
+    amp = 1.0 + dists / (dists.max() + 1.0)
+    lf = np.sin(2 * np.pi * lf_freq * t_sec)[:, None] * amp[None, :]
+    hf = 0.5 * np.sin(2 * np.pi * hf_freq * t_sec)[:, None]
+    out = lf + hf
+    if noise:
+        out = out + noise * rng.standard_normal(out.shape)
+    return out.astype(np.float32)
+
+
+def lowfreq_truth(times, dists, lf_freq=0.05):
+    """The recoverable low-frequency component at given datetimes."""
+    t_sec = (
+        times.astype("datetime64[ns]") - times[0].astype("datetime64[ns]")
+    ).astype(np.int64) / 1e9
+    amp = 1.0 + np.asarray(dists) / (np.asarray(dists).max() + 1.0)
+    return np.sin(2 * np.pi * lf_freq * t_sec)[:, None] * amp[None, :]
+
+
+def synthetic_patch(
+    t0=DEFAULT_T0,
+    duration=30.0,
+    fs=200.0,
+    n_ch=16,
+    d_ch=5.0,
+    gauge_length=10.0,
+    lf_freq=0.05,
+    hf_freq=25.0,
+    noise=0.0,
+    seed=0,
+    phase_origin=None,
+) -> Patch:
+    """One interrogator file's worth of synthetic data.
+
+    ``phase_origin`` makes the LF/HF phases continuous across files when
+    set to the stream start time.
+    """
+    n = int(round(duration * fs))
+    times = _time_axis(t0, n, fs)
+    origin = to_datetime64(phase_origin if phase_origin is not None else t0)
+    t_sec = (times - origin.astype("datetime64[ns]")).astype(np.int64) / 1e9
+    dists = np.arange(n_ch, dtype=np.float64) * d_ch
+    rng = np.random.default_rng(seed)
+    data = _signal(t_sec, dists, lf_freq, hf_freq, noise, rng)
+    return Patch(
+        data=data,
+        coords={"time": times, "distance": dists},
+        dims=("time", "distance"),
+        attrs={
+            "gauge_length": gauge_length,
+            "d_time": 1.0 / fs,
+            "d_distance": d_ch,
+        },
+    )
+
+
+def make_synthetic_spool(
+    directory,
+    n_files=4,
+    file_duration=30.0,
+    fs=200.0,
+    n_ch=16,
+    start=DEFAULT_T0,
+    **kwargs,
+):
+    """Write ``n_files`` contiguous dasdae files into ``directory``."""
+    os.makedirs(directory, exist_ok=True)
+    t0 = to_datetime64(start).astype("datetime64[ns]")
+    step = np.timedelta64(int(round(1e9 / fs)), "ns")
+    n = int(round(file_duration * fs))
+    paths = []
+    for i in range(n_files):
+        file_t0 = t0 + i * n * step
+        patch = synthetic_patch(
+            t0=file_t0,
+            duration=file_duration,
+            fs=fs,
+            n_ch=n_ch,
+            seed=i,
+            phase_origin=t0,
+            **kwargs,
+        )
+        path = os.path.join(directory, f"raw_{i:04d}.h5")
+        write_patch(patch, path, format="dasdae")
+        paths.append(path)
+    return paths

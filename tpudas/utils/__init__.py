@@ -1,0 +1,6 @@
+"""Utilities: structured logging, profiling counters."""
+
+from tpudas.utils.logging import log_event, set_log_handler
+from tpudas.utils.profiling import Timer, Counters
+
+__all__ = ["log_event", "set_log_handler", "Timer", "Counters"]

@@ -1,0 +1,34 @@
+"""Structured event logging.
+
+The reference observes progress with bare prints (lf_das.py:263 etc.);
+tpudas keeps those user-visible prints and adds machine-readable event
+lines behind an opt-in handler (off by default so notebook output
+matches the reference)."""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+_handler = None
+
+
+def set_log_handler(handler):
+    """Install a callable(event_dict) — or ``"stderr"`` for JSON lines,
+    or None to disable (default)."""
+    global _handler
+    if handler == "stderr":
+        def handler(event):  # noqa: F811
+            print(json.dumps(event, default=str), file=sys.stderr)
+    _handler = handler
+
+
+def log_event(name: str, **fields):
+    if _handler is None:
+        return
+    event = {"event": name, "ts": time.time(), **fields}
+    try:
+        _handler(event)
+    except Exception:
+        pass

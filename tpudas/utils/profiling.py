@@ -1,0 +1,51 @@
+"""Wall-clock timing and throughput counters.
+
+Equivalent of the notebooks' tic/toc harness
+(low_pass_dascore.ipynb:171-177) plus the BASELINE.md metrics:
+channel-samples/sec and real-time factor."""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+
+class Timer:
+    """``with Timer() as t: ...; t.elapsed`` — tic/toc."""
+
+    def __enter__(self):
+        self.start = time.perf_counter()
+        self.elapsed = None
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.perf_counter() - self.start
+        return False
+
+
+class Counters:
+    """Accumulates processed channel-samples and wall time; reports the
+    headline metrics."""
+
+    def __init__(self):
+        self.channel_samples = 0
+        self.data_seconds = 0.0
+        self.wall_seconds = 0.0
+
+    @contextmanager
+    def measure(self, channel_samples: int, data_seconds: float):
+        t0 = time.perf_counter()
+        yield
+        self.wall_seconds += time.perf_counter() - t0
+        self.channel_samples += int(channel_samples)
+        self.data_seconds += float(data_seconds)
+
+    @property
+    def channel_samples_per_sec(self) -> float:
+        return self.channel_samples / self.wall_seconds if self.wall_seconds else 0.0
+
+    @property
+    def realtime_factor(self) -> float:
+        """Data-seconds processed per wall-second (>1 means faster than
+        the stream)."""
+        return self.data_seconds / self.wall_seconds if self.wall_seconds else 0.0

@@ -1,0 +1,5 @@
+"""Visualization / QC plotting."""
+
+from tpudas.viz.waterfall import waterfall_plot, patch_waterfall
+
+__all__ = ["waterfall_plot", "patch_waterfall"]

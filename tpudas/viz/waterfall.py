@@ -1,0 +1,124 @@
+"""Waterfall (raster) QC plots.
+
+``waterfall_plot`` keeps the reference's exact signature and behavior
+(lf_das.py:110-178): bounds validation that prints and returns, a 95th-
+percentile symmetric clip, seismic colormap, measured-depth extent
+``(ch + ch_start) * spacing - surface_fiber``, 600-dpi JPEG output.
+``patch_waterfall`` backs ``Patch.viz.waterfall(scale=...)``
+(low_pass_dascore.ipynb cell 22)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["waterfall_plot", "patch_waterfall"]
+
+
+def waterfall_plot(
+    some_data,
+    min_sec,
+    max_sec,
+    min_ch,
+    max_ch,
+    ch_start,
+    channel_spacing,
+    surface_fiber,
+    sample_rate,
+    fig_title,
+    fig_dir,
+    fig_name,
+):
+    """QC raster of a (channel x time) array; saves ``fig_name``.jpeg."""
+    import matplotlib.pyplot as plt
+
+    some_data = np.asarray(some_data)
+    if (
+        (min_sec >= max_sec)
+        or (min_sec < 0)
+        or (max_sec * sample_rate > some_data.shape[1])
+    ):
+        print(
+            "ERROR in plotSpaceTime inputs minSec: "
+            + str(min_sec)
+            + " or maxSec: "
+            + str(max_sec)
+        )
+        return
+    if (min_ch >= max_ch) or (min_ch < 0) or (max_ch > some_data.shape[0]):
+        print(
+            "Error in plotSpaceTime inputs minCh: "
+            + str(min_ch)
+            + " or maxCh: "
+            + str(max_ch)
+            + " referring to array with "
+            + str(some_data.shape[0])
+            + " channels."
+        )
+        return
+
+    sec_lo = int(min_sec * sample_rate)
+    sec_hi = int(max_sec * sample_rate)
+    clip_val = np.percentile(np.absolute(some_data), 95)
+
+    plt.figure(figsize=(12, 8))
+    plt.imshow(
+        some_data[min_ch:max_ch, sec_lo:sec_hi],
+        aspect="auto",
+        interpolation="none",
+        cmap="seismic",
+        extent=(
+            min_sec,
+            max_sec,
+            (max_ch + ch_start) * channel_spacing - surface_fiber,
+            (min_ch + ch_start) * channel_spacing - surface_fiber,
+        ),
+        vmin=-clip_val,
+        vmax=clip_val,
+    )
+    plt.ylabel("MD (ft)", fontsize=10)
+    plt.xlabel("Time (sec)", fontsize=10)
+    plt.title(fig_title, fontsize=14)
+    plt.colorbar().set_label("Strain rate (1/s)", fontsize=10)
+    plt.savefig(f"{fig_dir}/{fig_name}.jpeg", dpi=600, format="jpeg")
+    plt.show()
+
+
+def patch_waterfall(patch, scale=None, ax=None, cmap="seismic", show=False):
+    """Waterfall of a Patch: time on x, distance on y, symmetric color
+    limits. ``scale`` (scalar) clips at ``scale * max|data|``; a (lo,
+    hi) pair sets limits directly."""
+    import matplotlib.pyplot as plt
+
+    data = patch.host_data()
+    tax = patch.axis_of("time")
+    if tax != 0:
+        data = data.T
+    finite = np.abs(data[np.isfinite(data)])
+    vmax = float(finite.max()) if finite.size else 1.0
+    if scale is None:
+        lim = (-vmax, vmax)
+    elif np.ndim(scale) == 0:
+        lim = (-float(scale) * vmax, float(scale) * vmax)
+    else:
+        lim = (float(scale[0]), float(scale[1]))
+
+    if ax is None:
+        _, ax = plt.subplots(figsize=(12, 8))
+    times = patch.coords["time"]
+    dists = patch.coords["distance"]
+    im = ax.imshow(
+        data.T,
+        aspect="auto",
+        interpolation="none",
+        cmap=cmap,
+        origin="upper",
+        extent=(0, float(len(times)), float(dists[-1]), float(dists[0])),
+        vmin=lim[0],
+        vmax=lim[1],
+    )
+    ax.set_xlabel("Time (samples)")
+    ax.set_ylabel("Distance (m)")
+    plt.colorbar(im, ax=ax).set_label("Amplitude")
+    if show:
+        plt.show()
+    return ax
