@@ -1,17 +1,23 @@
 """Repo-level pytest config: force a deterministic 8-device CPU mesh.
 
-Sharding / halo-exchange logic is tested without TPU hardware via
-XLA's host-platform device virtualization (SURVEY.md §4: "CPU tests
-with xla_force_host_platform_device_count=8"). Must run before jax
-initializes, hence env vars set at conftest import time.
+Sharding / halo-exchange logic is tested without TPU hardware via XLA's
+host-platform device virtualization (SURVEY.md §4: "CPU tests with
+xla_force_host_platform_device_count=8"). The hosting environment pins
+JAX_PLATFORMS to its TPU plugin and pre-imports jax from a
+sitecustomize, so setting env vars is not enough — we must also flip
+the platform via jax.config before any backend initialization.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("MPLBACKEND", "Agg")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

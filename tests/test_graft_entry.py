@@ -1,0 +1,23 @@
+"""Graft entry contract: jittable single-chip step + multichip dryrun."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import __graft_entry__ as ge
+
+
+def test_entry_jits_and_runs():
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    out = np.asarray(out)
+    assert out.shape == (1024, 256)
+    assert np.isfinite(out).all()
+
+
+@pytest.mark.parametrize("n", [4, 8])
+def test_dryrun_multichip(n):
+    if len(jax.devices()) < n:
+        pytest.skip("needs virtual devices")
+    ge.dryrun_multichip(n)

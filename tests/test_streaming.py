@@ -1,0 +1,202 @@
+"""Streaming drivers: polling, incremental processing, resume-with-
+overlap seam-freeness, termination — with injected sleep/clock."""
+
+import os
+
+import numpy as np
+import pytest
+
+from tpudas import spool
+from tpudas.core.units import s as sec
+from tpudas.io.registry import write_patch
+from tpudas.core.timeutils import to_datetime64
+from tpudas.proc.streaming import (
+    clamp_poll_interval,
+    run_lowpass_realtime,
+    run_rolling_realtime,
+)
+from tpudas.testing import make_synthetic_spool, synthetic_patch
+
+FS = 100.0
+FILE_SEC = 30.0
+NCH = 6
+
+
+def _append_files(directory, start_index, count):
+    t0 = to_datetime64("2023-03-22T00:00:00").astype("datetime64[ns]")
+    step = np.timedelta64(int(round(1e9 / FS)), "ns")
+    n = int(FILE_SEC * FS)
+    for i in range(start_index, start_index + count):
+        p = synthetic_patch(
+            t0=t0 + i * n * step, duration=FILE_SEC, fs=FS, n_ch=NCH,
+            seed=i, phase_origin=t0, noise=0.01,
+        )
+        write_patch(p, os.path.join(directory, f"raw_{i:04d}.h5"))
+
+
+class TestClamp:
+    def test_reference_cadence_guard(self):
+        # max(125, file_len); then >= 3x edge buffer
+        assert clamp_poll_interval(125, 30, 10) == 125
+        assert clamp_poll_interval(125, 300, 10) == 300
+        assert clamp_poll_interval(10, 5, 40) == 120.0
+
+
+class TestLowpassRealtime:
+    def test_rounds_resume_and_terminate(self, tmp_path):
+        src = str(tmp_path / "raw")
+        out = str(tmp_path / "results")
+        make_synthetic_spool(
+            src, n_files=3, file_duration=FILE_SEC, fs=FS, n_ch=NCH, noise=0.01
+        )
+        # feed two more files between rounds via the injected sleep
+        state = {"fed": 0}
+
+        def fake_sleep(_):
+            if state["fed"] < 1:
+                _append_files(src, 3, 2)
+                state["fed"] += 1
+
+        rounds = run_lowpass_realtime(
+            source=src,
+            output_folder=out,
+            start_time="2023-03-22T00:00:00",
+            output_sample_interval=1.0,
+            edge_buffer=8.0,
+            process_patch_size=40,
+            poll_interval=0.0,
+            file_duration=0.0,
+            sleep_fn=fake_sleep,
+        )
+        assert rounds == 2  # initial + one resume, then clean termination
+        merged = spool(out).update().chunk(time=None)
+        assert len(merged) == 1  # resumed output is seam-free
+        p = merged[0]
+        steps = np.diff(p.coords["time"].astype(np.int64))
+        assert np.all(steps == 1_000_000_000)
+        # covers (nearly) the whole 150 s stream minus edges
+        assert p.shape[0] > 120
+
+    def test_fractional_dt_resume_is_seam_free(self, tmp_path):
+        # regression: the resume rewind must stay on the output grid
+        # for non-integer-second output intervals
+        src = str(tmp_path / "raw")
+        out = str(tmp_path / "results")
+        make_synthetic_spool(
+            src, n_files=2, file_duration=FILE_SEC, fs=FS, n_ch=NCH, noise=0.01
+        )
+        state = {"fed": 0}
+
+        def fake_sleep(_):
+            if state["fed"] < 1:
+                _append_files(src, 2, 1)
+                state["fed"] += 1
+
+        rounds = run_lowpass_realtime(
+            source=src,
+            output_folder=out,
+            start_time="2023-03-22T00:00:00",
+            output_sample_interval=0.5,
+            edge_buffer=8.0,
+            process_patch_size=60,
+            poll_interval=0.0,
+            sleep_fn=fake_sleep,
+        )
+        assert rounds == 2
+        merged = spool(out).update().chunk(time=None)
+        assert len(merged) == 1
+        steps = np.diff(merged[0].coords["time"].astype(np.int64))
+        assert np.all(steps == 500_000_000)
+
+    def test_empty_source_terminates_with_max_rounds(self, tmp_path):
+        src = tmp_path / "empty_raw"
+        src.mkdir()
+        rounds = run_lowpass_realtime(
+            source=str(src),
+            output_folder=str(tmp_path / "out"),
+            start_time="2023-03-22T00:00:00",
+            output_sample_interval=1.0,
+            edge_buffer=8.0,
+            process_patch_size=40,
+            poll_interval=0.0,
+            sleep_fn=lambda _: None,
+            max_rounds=3,
+        )
+        assert rounds == 0
+
+    def test_max_rounds_cap(self, tmp_path):
+        src = str(tmp_path / "raw")
+        out = str(tmp_path / "results")
+        make_synthetic_spool(
+            src, n_files=3, file_duration=FILE_SEC, fs=FS, n_ch=NCH
+        )
+        rounds = run_lowpass_realtime(
+            source=src,
+            output_folder=out,
+            start_time="2023-03-22T00:00:00",
+            output_sample_interval=1.0,
+            edge_buffer=8.0,
+            process_patch_size=40,
+            poll_interval=0.0,
+            sleep_fn=lambda _: None,
+            max_rounds=1,
+        )
+        assert rounds == 1
+
+
+class TestRollingRealtime:
+    def test_processes_only_new_patches(self, tmp_path):
+        src = str(tmp_path / "raw")
+        out = str(tmp_path / "results")
+        make_synthetic_spool(
+            src, n_files=2, file_duration=FILE_SEC, fs=FS, n_ch=NCH
+        )
+        state = {"fed": 0}
+
+        def fake_sleep(_):
+            if state["fed"] < 1:
+                _append_files(src, 2, 1)
+                state["fed"] += 1
+
+        rounds = run_rolling_realtime(
+            source=src,
+            output_folder=out,
+            window=1.0 * sec,
+            step=1.0 * sec,
+            scale=2.0,
+            poll_interval=0.0,
+            sleep_fn=fake_sleep,
+        )
+        assert rounds == 2
+        outs = [f for f in os.listdir(out) if f.endswith(".h5")]
+        assert len(outs) == 3  # one output file per input patch
+        # stateless per-file: each output has its own NaN warm-up row
+        for p in spool(out).update():
+            host = p.host_data()
+            assert np.isnan(host[0]).all() and np.isfinite(host[1:]).all()
+
+    def test_out_of_order_arrival_still_processed(self, tmp_path):
+        # regression: a late-arriving file with an EARLIER timestamp
+        # must be processed (positional high-water marks skip it)
+        src = str(tmp_path / "raw")
+        out = str(tmp_path / "results")
+        os.makedirs(src)
+        _append_files(src, 2, 1)  # only the third file exists initially
+        state = {"fed": 0}
+
+        def fake_sleep(_):
+            if state["fed"] < 1:
+                _append_files(src, 0, 2)  # earlier files arrive late
+                state["fed"] += 1
+
+        rounds = run_rolling_realtime(
+            source=src,
+            output_folder=out,
+            window=1.0 * sec,
+            step=1.0 * sec,
+            poll_interval=0.0,
+            sleep_fn=fake_sleep,
+        )
+        assert rounds == 2
+        outs = [f for f in os.listdir(out) if f.endswith(".h5")]
+        assert len(outs) == 3  # all three inputs processed exactly once

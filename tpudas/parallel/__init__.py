@@ -1,0 +1,35 @@
+"""Parallel execution over TPU meshes.
+
+The reference is single-process (SURVEY.md §2.4); its implicit structure
+becomes explicit here, the TPU way:
+
+- **channel sharding** (zero-communication): every kernel is per-channel
+  1-D DSP, so sharding the channel axis over the mesh needs no
+  collectives at all — XLA partitions the jitted kernels automatically
+  given sharded inputs (:mod:`tpudas.parallel.sharding`).
+- **time/sequence sharding** with halo exchange: the engine's edge
+  buffer IS a halo; when the time axis is sharded, neighbors exchange
+  halos over ICI with ``lax.ppermute`` inside ``shard_map``
+  (:mod:`tpudas.parallel.halo`, :mod:`tpudas.parallel.pipeline`).
+- **data parallelism over patches/windows**: independent spool patches
+  batch into a leading axis sharded over devices
+  (:mod:`tpudas.parallel.batch`).
+- **multi-host** over DCN via ``jax.distributed``
+  (:mod:`tpudas.parallel.distributed`).
+"""
+
+from tpudas.parallel.mesh import make_mesh, device_count
+from tpudas.parallel.sharding import shard_channels, channel_sharding
+from tpudas.parallel.halo import exchange_halo_time
+from tpudas.parallel.pipeline import sharded_lowpass_decimate
+from tpudas.parallel.batch import batched_rolling_mean
+
+__all__ = [
+    "make_mesh",
+    "device_count",
+    "shard_channels",
+    "channel_sharding",
+    "exchange_halo_time",
+    "sharded_lowpass_decimate",
+    "batched_rolling_mean",
+]

@@ -1,0 +1,35 @@
+"""Data parallelism over independent patches.
+
+The rolling-mean paths process spool patches independently
+(rolling_mean_dascore.ipynb:147 is a serial for-loop; the *_edge
+variant is per-new-file). TPU-native: stack patches into a leading
+batch axis and shard it over the mesh — pure data parallelism, no
+collectives."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpudas.ops.rolling import _reduce_window_kernel
+
+__all__ = ["batched_rolling_mean"]
+
+
+def batched_rolling_mean(mesh, batch, w: int, s: int, batch_axis="ch"):
+    """Rolling mean over a (B, T, C) stack of windows/patches, batch
+    axis sharded over the mesh's ``batch_axis``.
+
+    Uses the same reduce_window kernel (and NaN warm-up semantics) as
+    the single-patch path, vmapped over the batch.
+    """
+    arr = jnp.asarray(batch, jnp.float32)
+    sharding = NamedSharding(mesh, P(batch_axis, None, None))
+    arr = jax.device_put(arr, sharding)
+    fn = jax.vmap(
+        functools.partial(_reduce_window_kernel, w=int(w), s=int(s), op="mean")
+    )
+    return jax.jit(fn, out_shardings=sharding)(arr)

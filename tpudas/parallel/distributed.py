@@ -1,0 +1,52 @@
+"""Multi-host (DCN) initialization hooks.
+
+Within a slice, collectives ride ICI and need no setup beyond the mesh.
+Across hosts (e.g. a v5e-64 spanning multiple workers — BASELINE.json
+config 5), JAX needs ``jax.distributed.initialize`` before first use;
+these wrappers gate that so single-host usage (and CPU test meshes) is
+untouched. The filesystem remains the durable inter-round channel, as
+in the reference's crash-only design (lf_das.py:214-217)."""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["initialize_multihost", "is_distributed", "global_mesh_devices"]
+
+_initialized = False
+
+
+def initialize_multihost(
+    coordinator_address=None, num_processes=None, process_id=None
+):
+    """Idempotent ``jax.distributed.initialize`` from args or env
+    (COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID). No-op when
+    single-process."""
+    global _initialized
+    if _initialized:
+        return False
+    coordinator_address = coordinator_address or os.environ.get(
+        "COORDINATOR_ADDRESS"
+    )
+    num_processes = num_processes or os.environ.get("NUM_PROCESSES")
+    process_id = process_id or os.environ.get("PROCESS_ID")
+    if not coordinator_address or num_processes is None or process_id is None:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=int(num_processes),
+        process_id=int(process_id),
+    )
+    _initialized = True
+    return True
+
+
+def is_distributed() -> bool:
+    return jax.process_count() > 1
+
+
+def global_mesh_devices():
+    """All devices across hosts, ordered for mesh construction."""
+    return jax.devices()

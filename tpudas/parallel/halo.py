@@ -1,0 +1,46 @@
+"""Halo exchange for a sharded time axis.
+
+The overlap-save edge buffer is a halo (SURVEY.md §5, long-context):
+when the time axis of a resident block is sharded across devices, each
+shard needs ``halo`` samples from its neighbors before filtering so the
+trimmed interior is seam-free. ``lax.ppermute`` moves the halos over
+ICI neighbor links (ring topology — the same primitive ring attention
+uses); boundary shards receive zeros, which is exactly the zero-padded
+stream-boundary semantics the host-side engine has.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["exchange_halo_time"]
+
+
+def exchange_halo_time(block, halo: int, axis_name: str = "time",
+                       n_shards: int | None = None):
+    """Inside shard_map: return block extended with neighbor halos.
+
+    block: (T_local, ...) — the local time shard. Returns
+    ``(T_local + 2*halo, ...)``; call sites trim ``halo`` from each end
+    of the processed result to keep only valid interior.
+    """
+    if halo <= 0:
+        return block
+    if halo > block.shape[0]:
+        raise ValueError(
+            f"halo ({halo}) exceeds the local time-shard length "
+            f"({block.shape[0]}); use fewer time shards or a longer block"
+        )
+    if n_shards is None:
+        n_shards = jax.lax.axis_size(axis_name)
+    if n_shards == 1:
+        pad = jnp.zeros((halo,) + block.shape[1:], block.dtype)
+        return jnp.concatenate([pad, block, pad], axis=0)
+    fwd = [(i, i + 1) for i in range(n_shards - 1)]
+    bwd = [(i + 1, i) for i in range(n_shards - 1)]
+    # my tail -> right neighbor's left halo; my head -> left neighbor's
+    # right halo. Unmatched shards (stream boundaries) receive zeros.
+    from_left = jax.lax.ppermute(block[-halo:], axis_name, fwd)
+    from_right = jax.lax.ppermute(block[:halo], axis_name, bwd)
+    return jnp.concatenate([from_left, block, from_right], axis=0)

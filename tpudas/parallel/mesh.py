@@ -1,0 +1,35 @@
+"""Device mesh construction."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["make_mesh", "device_count"]
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def make_mesh(n_devices=None, time_shards=1, axis_names=("time", "ch")) -> Mesh:
+    """A 2-D (time, channel) mesh over the first ``n_devices`` devices.
+
+    ``time_shards=1`` (default) gives pure channel sharding — the
+    zero-communication layout, first choice since the kernels are
+    channel-independent (SURVEY.md §2.4). Raise ``time_shards`` to
+    shard long resident blocks along time (halo exchange then rides
+    ICI neighbors).
+    """
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[: int(n_devices)]
+    n = len(devices)
+    if n % time_shards != 0:
+        raise ValueError(
+            f"time_shards={time_shards} must divide device count {n}"
+        )
+    grid = np.array(devices).reshape(time_shards, n // time_shards)
+    return Mesh(grid, axis_names)

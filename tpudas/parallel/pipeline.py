@@ -1,0 +1,95 @@
+"""Sharded end-to-end window pipeline: filter + decimate over a mesh.
+
+The multi-device form of the engine's fused window kernel
+(tpudas.proc.lfproc): a resident (T, C) super-block is laid out over a
+(time, ch) mesh; each device filters its time shard plus exchanged
+halos locally (FFT overlap-save — circular artifacts fall inside the
+trimmed halo), then decimates its interior by strided subsampling.
+Channel direction needs no communication at all; time direction costs
+one neighbor ppermute of ``halo`` rows per step.
+
+Alignment requirements (checked): T divisible by time-shards, local
+block divisible by the decimation ratio, C divisible by channel shards.
+The streaming host path (LFProc) has no such constraints; this path is
+for resident super-batches on a slice (BASELINE.json configs 4-5).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from tpudas.ops.fftlen import next_tpu_fft_len
+from tpudas.parallel.halo import exchange_halo_time
+
+__all__ = ["sharded_lowpass_decimate"]
+
+
+def _local_filter_decimate(padded, d_sec, corner, order, halo, t_local, ratio):
+    """Filter a halo-padded local block, trim, stride-decimate."""
+    nfft = next_tpu_fft_len(int(padded.shape[0]))
+    spec = jnp.fft.rfft(padded, n=nfft, axis=0)
+    freqs = jnp.arange(nfft // 2 + 1, dtype=jnp.float32) / (nfft * d_sec)
+    resp = 1.0 / (1.0 + (freqs / corner) ** (2 * order))
+    filt = jnp.fft.irfft(spec * resp[:, None], n=nfft, axis=0)
+    interior = jax.lax.slice_in_dim(filt, halo, halo + t_local, axis=0)
+    return interior[::ratio].astype(padded.dtype)
+
+
+def sharded_lowpass_decimate(
+    mesh, data, d_sec, corner, ratio, halo, order=4,
+    time_axis="time", ch_axis="ch",
+):
+    """Run the fused low-pass + decimate over a (time, ch) mesh.
+
+    data: (T, C) float32 (host or device). Returns (T // ratio, C) with
+    the same global result as the single-device kernel up to halo
+    truncation (callers discard ``halo`` input samples at each stream
+    end, as the engine's edge buffer already does).
+    """
+    T, C = data.shape
+    nt = mesh.shape[time_axis]
+    nc = mesh.shape[ch_axis]
+    if T % nt != 0:
+        raise ValueError(f"T={T} not divisible by time shards {nt}")
+    t_local = T // nt
+    if t_local % ratio != 0:
+        raise ValueError(
+            f"local block {t_local} not divisible by decimation ratio {ratio}"
+        )
+    if C % nc != 0:
+        raise ValueError(f"C={C} not divisible by channel shards {nc}")
+    if halo >= t_local:
+        raise ValueError(f"halo {halo} must be < local block {t_local}")
+
+    spec_2d = P(time_axis, ch_axis)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec_2d,),
+        out_specs=spec_2d,
+        check_vma=False,
+    )
+    def step(block):
+        padded = exchange_halo_time(
+            block, halo, axis_name=time_axis, n_shards=nt
+        )
+        return _local_filter_decimate(
+            padded,
+            jnp.float32(d_sec),
+            jnp.float32(corner),
+            order,
+            halo,
+            t_local,
+            ratio,
+        )
+
+    arr = jax.device_put(
+        jnp.asarray(data, jnp.float32), NamedSharding(mesh, spec_2d)
+    )
+    return jax.jit(step)(arr)

@@ -1,0 +1,25 @@
+"""Channel-axis sharding: the zero-communication layout.
+
+Every tpudas kernel operates independently per channel, so a
+``(time, channel)`` block sharded as ``P(None, "ch")`` runs the jitted
+kernels with NO collectives — XLA partitions the FFT / gather /
+reduce_window column-wise automatically. This is the first-choice
+production layout (BASELINE.json: "channels sharded over v5e-8")."""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["channel_sharding", "shard_channels"]
+
+
+def channel_sharding(mesh, ch_axis="ch") -> NamedSharding:
+    """Sharding for a (time, channel) array: replicate time, split
+    channels over every mesh axis-size along ``ch_axis``."""
+    return NamedSharding(mesh, P(None, ch_axis))
+
+
+def shard_channels(array, mesh, ch_axis="ch"):
+    """Place a (T, C) array with channels sharded over the mesh."""
+    return jax.device_put(array, channel_sharding(mesh, ch_axis))

@@ -1,0 +1,175 @@
+"""Real-time ("edge") streaming drivers.
+
+Library form of the two *_edge notebooks' polling loops (SURVEY.md
+§3.2): poll the source directory, process what's new, sleep, repeat;
+terminate when the spool stops growing. State is only the output
+directory (crash-only): kill the process anywhere and the next run
+resumes from ``get_last_processed_time`` with the edge-buffer rewind
+``t1 = t_last - (ceil(edge/dt) - 1) * dt``
+(low_pass_dascore_edge.ipynb:228-231) — which lands exactly one output
+sample past the last emitted one, so resumed output is seam-free.
+
+``poll_interval`` defaults to the reference's cadence clamp
+``max(125 s, file duration, 3 * edge_buffer)``
+(low_pass_dascore_edge.ipynb:165-173); tests inject ``sleep_fn`` and
+``max_rounds``.
+"""
+
+from __future__ import annotations
+
+import math
+import time as _time
+
+import numpy as np
+
+from tpudas.core.timeutils import to_datetime64, to_timedelta64
+from tpudas.io.spool import spool as make_spool
+from tpudas.proc.lfproc import LFProc
+from tpudas.proc.naming import get_filename
+from tpudas.utils.logging import log_event
+
+__all__ = ["clamp_poll_interval", "run_lowpass_realtime", "run_rolling_realtime"]
+
+
+def clamp_poll_interval(requested, file_duration, edge_buffer):
+    """The reference's cadence guard: never poll faster than one file's
+    duration or 1.5x the two-sided edge buffer."""
+    interval = max(float(requested), float(file_duration))
+    if interval < 2 * edge_buffer * 1.5:
+        interval = 2 * edge_buffer * 1.5
+    return interval
+
+
+def run_lowpass_realtime(
+    source,
+    output_folder,
+    start_time,
+    output_sample_interval,
+    edge_buffer,
+    process_patch_size,
+    distance=None,
+    poll_interval=125.0,
+    file_duration=0.0,
+    max_rounds=None,
+    sleep_fn=_time.sleep,
+    on_round=None,
+):
+    """Poll ``source`` and keep the low-pass output current.
+
+    Returns the number of rounds that processed data. Terminates when a
+    poll sees no new files (reference semantics) or after
+    ``max_rounds``.
+    """
+    d_t = float(output_sample_interval)
+    buff_out = int(np.ceil(edge_buffer / d_t))
+    interval = clamp_poll_interval(poll_interval, file_duration, edge_buffer)
+    start_time = to_datetime64(start_time)
+
+    initial_run = True
+    rounds = 0
+    polls = 0
+    len_last = None
+    while True:
+        polls += 1
+        sp = make_spool(source).update()
+        sub = sp.select(distance=distance) if distance is not None else sp
+        n_now = len(sub)
+        if not initial_run and n_now == len_last:
+            print("No new data was detected. Real-time processing ended successfully.")
+            break
+        if n_now > 0:
+            lfp = LFProc(sub)
+            lfp.update_processing_parameter(
+                output_sample_interval=d_t,
+                process_patch_size=int(process_patch_size),
+                edge_buff_size=buff_out,
+            )
+            lfp.set_output_folder(output_folder, delete_existing=False)
+            rounds += 1
+            print("run number: ", rounds)
+            if initial_run:
+                t1 = start_time
+                initial_run = False
+            else:
+                t_last = lfp.get_last_processed_time()
+                # rewind (ceil(edge/dt) - 1) output steps, exactly on the
+                # output grid — ns precision so fractional d_t stays
+                # seam-free (the resumed run's first emitted sample is
+                # then t_last + d_t)
+                rewind_sec = (math.ceil(edge_buffer / d_t) - 1) * d_t
+                t1 = t_last - to_timedelta64(rewind_sec)
+            # newest timestamp from the index — no file data is read
+            t2 = np.datetime64(sub.get_contents()["time_max"].max())
+            lfp.process_time_range(t1, t2)
+            log_event("realtime_round", round=rounds, upto=str(t2))
+            if on_round is not None:
+                on_round(rounds, lfp)
+            len_last = n_now
+        if max_rounds is not None and polls >= max_rounds:
+            break
+        sleep_fn(interval)
+    return rounds
+
+
+def run_rolling_realtime(
+    source,
+    output_folder,
+    window,
+    step,
+    scale=1.0,
+    distance=None,
+    poll_interval=None,
+    file_duration=30.0,
+    max_rounds=None,
+    sleep_fn=_time.sleep,
+    engine=None,
+):
+    """Poll ``source`` and rolling-mean each NEW patch (stateless per
+    file — rolling_mean_dascore_edge.ipynb:209-221). Returns rounds
+    that processed data."""
+    import os
+
+    os.makedirs(output_folder, exist_ok=True)
+    interval = float(poll_interval) if poll_interval is not None else float(
+        file_duration
+    )
+    initial_run = True
+    rounds = 0
+    polls = 0
+    # identify patches by their time span so a late-arriving file with
+    # an earlier timestamp is still processed (a positional high-water
+    # mark into the time-sorted spool would skip it silently)
+    processed: set = set()
+    while True:
+        polls += 1
+        sp = make_spool(source).sort("time").update()
+        sub = sp.select(distance=distance) if distance is not None else sp
+        contents = sub.get_contents()
+        keys = [
+            (np.datetime64(a, "ns"), np.datetime64(b, "ns"))
+            for a, b in zip(contents["time_min"], contents["time_max"])
+        ]
+        fresh = [j for j, k in enumerate(keys) if k not in processed]
+        if not initial_run and not fresh:
+            print("No new data was detected. Real-time data processing ended successfully.")
+            break
+        if fresh:
+            rounds += 1
+            print("run number: ", rounds)
+            for j in fresh:
+                patch = sub[j]
+                print("working on patch ", j)
+                out = patch.rolling(
+                    time=window, step=step, engine=engine
+                ).mean()
+                out = out.new(data=np.asarray(out.data) * scale)
+                fname = get_filename(
+                    out.attrs["time_min"], out.attrs["time_max"]
+                )
+                out.io.write(os.path.join(output_folder, fname), "dasdae")
+                processed.add(keys[j])
+        initial_run = False
+        if max_rounds is not None and polls >= max_rounds:
+            break
+        sleep_fn(interval)
+    return rounds
