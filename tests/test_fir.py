@@ -1,0 +1,225 @@
+"""Multistage FIR cascade engine (tpudas.ops.fir / pallas_fir):
+design-response match to the reference's Butterworth-squared filter,
+XLA/Pallas agreement, and LFProc engine equivalence (SURVEY.md §4:
+filter kernel vs golden outputs, tolerance-based)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpudas.ops.filter import fft_pass_filter
+from tpudas.ops.fir import (
+    butter2_mag,
+    cascade_decimate,
+    design_cascade,
+    edge_support_samples,
+    factor_ratio,
+    impulse_response,
+)
+
+FS = 1000.0
+CORNER = 0.45
+
+
+class TestDesign:
+    def test_factor_ratio(self):
+        assert factor_ratio(1000) == [8, 5, 5, 5]
+        assert factor_ratio(100) == [5, 5, 4]
+        assert factor_ratio(10) == [5, 2]
+        assert factor_ratio(8) == [8]
+        assert factor_ratio(1) == []
+
+    def test_factor_ratio_large_prime_rejected(self):
+        with pytest.raises(ValueError, match="prime factor"):
+            factor_ratio(13)
+
+    @pytest.mark.parametrize(
+        "fs,ratio,corner",
+        [(1000.0, 1000, 0.45), (100.0, 100, 0.45), (100.0, 10, 4.5)],
+    )
+    def test_composite_response_matches_butter2(self, fs, ratio, corner):
+        """|H_cascade(f)| == butter2_mag(f) on the retained band to
+        ~1e-4 — the engine-parity contract with tpudas.ops.filter."""
+        plan = design_cascade(fs, ratio, corner, 4)
+        h = impulse_response(plan)
+        nfft = 1 << 18
+        H = np.abs(np.fft.rfft(h, nfft))
+        freqs = np.arange(nfft // 2 + 1) / nfft * fs
+        band = freqs <= 0.5 * fs / ratio
+        err = np.abs(H[band] - butter2_mag(freqs[band], corner, 4))
+        assert err.max() < 1e-4
+
+    def test_delay_is_symmetry_center(self):
+        plan = design_cascade(FS, 1000, CORNER, 4)
+        h = impulse_response(plan)
+        # linear phase: response symmetric about the composite delay
+        d = plan.delay
+        w = min(d, len(h) - 1 - d)
+        left = h[d - w : d]
+        right = h[d + 1 : d + 1 + w][::-1]
+        assert np.abs(left - right).max() < 1e-12
+        assert plan.receptive_field == 2 * d + 1
+
+    def test_edge_support_shrinks_with_looser_tol(self):
+        plan = design_cascade(FS, 1000, CORNER, 4)
+        assert edge_support_samples(plan, 1e-2) <= edge_support_samples(
+            plan, 1e-4
+        )
+        # support is inside the receptive field
+        assert edge_support_samples(plan, 1e-3) <= plan.delay
+
+
+def _bandlimited(T, C, fs, seed=0):
+    rng = np.random.default_rng(seed)
+    t = np.arange(T) / fs
+    x = np.zeros((T, C), np.float32)
+    for c in range(C):
+        for f, a in [(0.05, 1.0), (0.21, 0.7), (0.38, 0.4)]:
+            x[:, c] += a * np.sin(
+                2 * np.pi * f * t + rng.uniform(0, 2 * np.pi)
+            ).astype(np.float32)
+    x += rng.standard_normal((T, C)).astype(np.float32) * 0.1
+    return x
+
+
+class TestApply:
+    def test_matches_fft_engine_interior(self):
+        """Cascade output == FFT-engine zero-phase filter at the
+        decimated sample points, away from edges."""
+        ratio, T, C = 1000, 40000, 4
+        plan = design_cascade(FS, ratio, CORNER, 4)
+        x = _bandlimited(T, C, FS)
+        ref_full = np.asarray(
+            fft_pass_filter(jnp.asarray(x), 1.0 / FS, high=CORNER, order=4)
+        )
+        phase, n_out = 14000, 12
+        ref = ref_full[phase : phase + n_out * ratio : ratio]
+        got = np.asarray(cascade_decimate(x, plan, phase, n_out, engine="xla"))
+        scale = np.abs(ref).max()
+        assert np.abs(got - ref).max() < 1e-4 * scale
+
+    def test_phase_shift_consistency(self):
+        """Outputs at the same absolute sample index agree regardless of
+        the window phase — the property that makes chunked processing
+        seam-free."""
+        ratio = 100
+        plan = design_cascade(100.0, ratio, CORNER, 4)
+        x = _bandlimited(8000, 3, 100.0, seed=1)
+        a = np.asarray(cascade_decimate(x, plan, 3000, 10, engine="xla"))
+        b = np.asarray(cascade_decimate(x, plan, 3000 + 2 * ratio, 8, engine="xla"))
+        assert np.abs(a[2:] - b[:8]).max() < 1e-6
+
+    def test_pallas_interpret_matches_xla(self):
+        ratio = 100
+        plan = design_cascade(100.0, ratio, CORNER, 4)
+        x = _bandlimited(30000, 130, 100.0, seed=2)  # non-multiple C
+        a = np.asarray(cascade_decimate(x, plan, 6000, 16, engine="xla"))
+        b = np.asarray(cascade_decimate(x, plan, 6000, 16, engine="pallas"))
+        assert np.abs(a - b).max() < 1e-6
+
+    def test_left_pad_when_phase_before_delay(self):
+        plan = design_cascade(100.0, 100, CORNER, 4)
+        x = _bandlimited(4000, 2, 100.0, seed=3)
+        out = np.asarray(cascade_decimate(x, plan, 0, 4, engine="xla"))
+        assert out.shape == (4, 2)
+        assert np.isfinite(out).all()
+
+
+class TestPallasKernel:
+    def test_strided_fir_exact(self):
+        """Kernel output == direct numpy correlation at stride R."""
+        from tpudas.ops.pallas_fir import fir_decimate_pallas
+
+        rng = np.random.default_rng(0)
+        T, C, R, L = 2048, 140, 8, 33
+        x = rng.standard_normal((T, C)).astype(np.float32)
+        h = rng.standard_normal(L).astype(np.float32)
+        B = -(-L // R)
+        hp = np.zeros(B * R, np.float32)
+        hp[:L] = h
+        n_out = T // R - B
+        got = np.asarray(
+            fir_decimate_pallas(
+                jnp.asarray(x),
+                jnp.asarray(hp.reshape(B, R)),
+                R,
+                n_out=n_out,
+                interpret=True,
+            )
+        )
+        ref = np.zeros((n_out, C), np.float32)
+        for k in range(n_out):
+            seg = x[k * R : k * R + L]
+            ref[k] = (h[:, None] * seg).sum(0)
+        assert np.abs(got - ref).max() < 1e-4 * np.abs(ref).max()
+
+    def test_too_many_taps_rejected(self):
+        from tpudas.ops.pallas_fir import fir_decimate_pallas
+
+        x = jnp.zeros((4096, 128), jnp.float32)
+        hb = jnp.zeros((200, 2), jnp.float32)  # 200 frames > 128 block
+        with pytest.raises(ValueError, match="tap frames"):
+            fir_decimate_pallas(x, hb, 2, n_out=64, interpret=True)
+
+
+class TestLFProcEngines:
+    def test_cascade_equals_fft_engine(self, tmp_path):
+        """Full chunked runs with engine='fft' vs engine='cascade' agree
+        on the interior — engine choice is an implementation detail."""
+        from tpudas import spool
+        from tpudas.proc.lfproc import LFProc
+        from tpudas.testing import make_synthetic_spool
+
+        d = tmp_path / "raw"
+        make_synthetic_spool(
+            d, n_files=6, file_duration=30.0, fs=100.0, n_ch=6, noise=0.01
+        )
+        outs = {}
+        for engine in ("fft", "cascade"):
+            lfp = LFProc(spool(str(d)).sort("time").update())
+            lfp.update_processing_parameter(
+                output_sample_interval=1.0,
+                process_patch_size=60,
+                edge_buff_size=10,
+                engine=engine,
+            )
+            out_dir = tmp_path / engine
+            lfp.set_output_folder(str(out_dir), delete_existing=True)
+            lfp.process_time_range(
+                np.datetime64("2023-03-22T00:00:00"),
+                np.datetime64("2023-03-22T00:03:00"),
+            )
+            outs[engine] = spool(str(out_dir)).update().chunk(time=None)[0]
+        a, b = outs["fft"], outs["cascade"]
+        lo = max(a.coords["time"][0], b.coords["time"][0])
+        hi = min(a.coords["time"][-1], b.coords["time"][-1])
+        da = a.select(time=(lo, hi)).host_data()
+        db = b.select(time=(lo, hi)).host_data()
+        scale = np.abs(da).max()
+        assert np.abs(da - db).max() < 5e-3 * scale
+
+    def test_cascade_engine_rejects_misaligned(self, tmp_path):
+        """engine='cascade' on a non-sample-aligned grid raises with
+        guidance (engine='auto' would silently fall back to FFT)."""
+        from tpudas import spool
+        from tpudas.proc.lfproc import LFProc
+        from tpudas.testing import make_synthetic_spool
+
+        d = tmp_path / "raw"
+        make_synthetic_spool(
+            d, n_files=2, file_duration=30.0, fs=100.0, n_ch=4, noise=0.0
+        )
+        lfp = LFProc(spool(str(d)).sort("time").update())
+        lfp.update_processing_parameter(
+            output_sample_interval=0.333,  # 333 ms: non-integer ratio
+            process_patch_size=60,
+            edge_buff_size=10,
+            engine="cascade",
+        )
+        lfp.set_output_folder(str(tmp_path / "out"), delete_existing=True)
+        with pytest.raises(ValueError, match="cascade"):
+            lfp.process_time_range(
+                np.datetime64("2023-03-22T00:00:00"),
+                np.datetime64("2023-03-22T00:01:00"),
+            )

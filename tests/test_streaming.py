@@ -200,3 +200,60 @@ class TestRollingRealtime:
         assert rounds == 2
         outs = [f for f in os.listdir(out) if f.endswith(".h5")]
         assert len(outs) == 3  # all three inputs processed exactly once
+
+
+class TestTerminationAndRecovery:
+    def test_empty_source_terminates_without_max_rounds(self, tmp_path):
+        """A source that never produces files must end the loop on the
+        second empty poll, not spin forever (reference semantics: the
+        loop ends when the spool stops growing)."""
+        src = tmp_path / "raw"
+        src.mkdir()
+        polls = {"n": 0}
+
+        def guarded_sleep(_):
+            polls["n"] += 1
+            if polls["n"] > 5:
+                raise AssertionError("realtime loop failed to terminate")
+
+        rounds = run_lowpass_realtime(
+            source=str(src),
+            output_folder=str(tmp_path / "out"),
+            start_time="2023-03-22T00:00:00",
+            output_sample_interval=1.0,
+            edge_buffer=5.0,
+            process_patch_size=40,
+            poll_interval=0.0,
+            sleep_fn=guarded_sleep,
+        )
+        assert rounds == 0
+
+    def test_resume_after_round_with_no_output(self, tmp_path):
+        """A round that completes without emitting files (stream still
+        behind start_time) must not crash the next round's resume — it
+        retries from start_time instead (crash-only contract)."""
+        src = str(tmp_path / "raw")
+        out = str(tmp_path / "out")
+        # file 0 covers 00:00:00-00:00:30, far before start_time
+        make_synthetic_spool(
+            src, n_files=1, file_duration=FILE_SEC, fs=FS, n_ch=NCH
+        )
+
+        def feed_late(_):
+            # file at index 20 covers 00:10:00-00:10:30 (= start_time)
+            if not any(f.startswith("raw_0020") for f in os.listdir(src)):
+                _append_files(src, 20, 1)
+
+        rounds = run_lowpass_realtime(
+            source=src,
+            output_folder=out,
+            start_time="2023-03-22T00:10:00",
+            output_sample_interval=1.0,
+            edge_buffer=3.0,
+            process_patch_size=20,
+            poll_interval=0.0,
+            sleep_fn=feed_late,
+        )
+        assert rounds == 2
+        produced = [f for f in os.listdir(out) if f.endswith(".h5")]
+        assert produced  # the second round recovered and emitted output

@@ -1,0 +1,397 @@
+"""Multistage polyphase FIR decimation — the fast path of the engine.
+
+The reference's hot loop filters the FULL-rate stream with a zero-phase
+IIR and then throws away ~99.9% of the samples at the interpolation
+step (reference lf_das.py:223-225: ``pass_filter`` at corner
+``0.45/dt`` followed by ``interpolate`` onto the decimated grid). The
+FFT engine (tpudas.ops.filter) reproduces that shape faithfully but
+pays O(T log T) and several full-rate HBM passes per window.
+
+This module exploits the decimating structure instead: a cascade of
+small linear-phase FIR stages, each decimating by an integer factor,
+designed so the *composite* magnitude response matches the reference's
+zero-phase Butterworth-squared response ``1/(1+(f/fc)^(2*order))`` on
+the retained band. Compute per input sample drops from O(log T) FFT
+passes to ~4-6 multiply-adds, all in one streaming pass — the shape
+TPUs (and the Pallas kernel in tpudas.ops.pallas_fir) like.
+
+Design scheme
+-------------
+- ``factor_ratio`` splits the decimation ratio into integer stages
+  (large factors first, so the full-rate stage is the cheapest).
+- every stage except the last is a plain anti-alias guard: a
+  Kaiser-windowed low-pass whose stopband starts where energy would
+  fold back into the final retained band. Its passband covers the
+  final band with ~1e-4 ripple.
+- the last stage is *response-matched*: a zero-phase frequency-sampled
+  FIR of the desired composite response divided by the measured
+  response of the guard stages, so the cascade's end-to-end magnitude
+  equals the Butterworth-squared target within truncation ripple.
+- all stages have odd length, so the composite group delay is an
+  integer number of full-rate samples (``CascadePlan.delay``); the
+  caller re-indexes outputs by that delay, which makes the cascade
+  zero-phase exactly like the reference's forward-backward filter.
+
+Correctness is tolerance-based against the FFT engine (the same way
+the reference treats its own edges: the self-calibration probe at
+lf_das.py:47-87 thresholds the impulse response at ``max*tol``);
+``impulse_response``/``edge_support_samples`` provide that probe for
+this engine analytically.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = [
+    "CascadePlan",
+    "factor_ratio",
+    "design_cascade",
+    "cascade_decimate",
+    "impulse_response",
+    "edge_support_samples",
+    "butter2_mag",
+]
+
+
+def butter2_mag(f, corner, order):
+    """The reference's zero-phase magnitude: ``|H_butter|^2`` of an
+    ``order``-pole Butterworth low-pass (sosfiltfilt applies the filter
+    twice, squaring the magnitude — tpudas.ops.filter matches this)."""
+    f = np.asarray(f, np.float64)
+    return 1.0 / (1.0 + (f / float(corner)) ** (2 * int(order)))
+
+
+def factor_ratio(ratio: int) -> list[int]:
+    """Split an integer decimation ratio into stage factors in [2, 8],
+    largest first. Raises if a prime factor > 8 remains."""
+    ratio = int(ratio)
+    if ratio < 1:
+        raise ValueError(f"decimation ratio must be >= 1, got {ratio}")
+    factors = []
+    rem = ratio
+    while rem > 1:
+        for f in (8, 7, 6, 5, 4, 3, 2):
+            if rem % f == 0:
+                factors.append(f)
+                rem //= f
+                break
+        else:
+            raise ValueError(
+                f"ratio {ratio} has a prime factor > 8; "
+                "use the FFT engine for this ratio"
+            )
+    factors.sort(reverse=True)
+    return factors
+
+
+@dataclass(frozen=True, eq=False)
+class CascadePlan:
+    """A compiled multistage decimation filter.
+
+    stages: tuple of (R, taps) — taps are float32, odd length.
+    ratio:  product of all R.
+    delay:  composite group delay in FULL-RATE samples (integer,
+            because every stage is odd-length linear-phase);
+            causal cascade output ``k`` is the zero-phase filtered
+            input at full-rate index ``k*ratio + delay``.
+    fs_in / corner / order: the design point.
+
+    Hash/eq are by tap content so plans can key jit caches.
+    """
+
+    stages: tuple
+    ratio: int
+    delay: int
+    fs_in: float
+    corner: float
+    order: int
+
+    @property
+    def receptive_field(self) -> int:
+        """Total taps footprint in full-rate samples (= 2*delay + 1)."""
+        return 2 * self.delay + 1
+
+    def _fingerprint(self):
+        return (
+            self.ratio,
+            self.delay,
+            tuple(
+                (int(R), np.asarray(h).tobytes()) for R, h in self.stages
+            ),
+        )
+
+    def __hash__(self):
+        return hash(self._fingerprint())
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, CascadePlan)
+            and self._fingerprint() == other._fingerprint()
+        )
+
+
+def _guard_stage_taps(fs_in: float, R: int, f_keep: float) -> np.ndarray:
+    """Anti-alias guard: keep [0, f_keep] intact, attenuate everything
+    that decimation by R would fold back onto [0, f_keep]."""
+    from scipy.signal import firwin, kaiserord
+
+    fs_out = fs_in / R
+    stop = fs_out - f_keep  # first fold-back edge
+    pass_edge = f_keep
+    width = max(stop - pass_edge, 0.05 * fs_in / R)
+    numtaps, beta = kaiserord(80.0, width / (0.5 * fs_in))
+    numtaps = max(numtaps, 9)
+    if numtaps % 2 == 0:
+        numtaps += 1
+    cutoff = 0.5 * (pass_edge + stop)
+    return firwin(
+        numtaps, cutoff, window=("kaiser", beta), fs=fs_in
+    ).astype(np.float32)
+
+
+def _stage_response(taps: np.ndarray, fs: float, freqs: np.ndarray):
+    """Real-valued magnitude response of a symmetric (linear-phase) FIR
+    at ``freqs`` Hz (phase removed analytically)."""
+    n = np.arange(len(taps), dtype=np.float64) - (len(taps) - 1) / 2.0
+    ang = 2.0 * np.pi * np.asarray(freqs, np.float64)[:, None] * n[None, :] / fs
+    return (np.cos(ang) @ np.asarray(taps, np.float64)).astype(np.float64)
+
+
+def _matched_last_stage(
+    fs_l: float,
+    corner: float,
+    order: int,
+    guard_resp,
+    taps: int | None,
+) -> np.ndarray:
+    """Frequency-sampled zero-phase FIR matching
+    ``butter2_mag / guard_resp`` on [0, fs_l/2]."""
+    nfft = 16384
+    freqs = np.arange(nfft // 2 + 1, dtype=np.float64) * fs_l / nfft
+    desired = butter2_mag(freqs, corner, order)
+    g = np.clip(guard_resp(freqs), 1e-3, None)
+    d = np.where(desired > 1e-8, desired / g, 0.0)
+    h_full = np.fft.irfft(d, n=nfft)  # symmetric around index 0
+    h_c = np.concatenate([h_full[nfft // 2 :], h_full[: nfft // 2]])
+    center = nfft // 2
+    if taps is None:
+        mag = np.abs(h_c)
+        thresh = mag.max() * 1e-6
+        above = np.nonzero(mag > thresh)[0]
+        half = int(
+            max(center - above[0], above[-1] - center, 4)
+        )
+        taps = min(2 * half + 1, 4095)
+    if taps % 2 == 0:
+        taps += 1
+    half = taps // 2
+    h = h_c[center - half : center + half + 1].copy()
+    # no taper: the target response is smooth, so the frequency-sampled
+    # impulse response decays below 1e-6 before truncation and plain
+    # truncation keeps the band error ~1e-6 (a Kaiser taper would bias
+    # the passband by ~1e-2). Renormalize DC to the exact target gain.
+    dc_target = d[0]
+    s = h.sum()
+    if s != 0:
+        h *= dc_target / s
+    return h.astype(np.float32)
+
+
+@functools.lru_cache(maxsize=64)
+def design_cascade(
+    fs_in: float,
+    ratio: int,
+    corner: float,
+    order: int = 4,
+    last_taps: int | None = None,
+) -> CascadePlan:
+    """Design the multistage decimator for ``fs_in -> fs_in/ratio`` with
+    composite response ``butter2_mag(f, corner, order)``.
+
+    The retained band is [0, 0.5*fs_in/ratio] (the output Nyquist);
+    guard stages protect it from aliasing at >= 80 dB, and the last
+    stage shapes the composite response to the Butterworth-squared
+    target of the reference engine (lf_das.py:223).
+    """
+    factors = factor_ratio(ratio)
+    f_out = fs_in / ratio
+    f_keep = 0.5 * f_out
+    stages = []
+    fs = fs_in
+    guard_list = []
+    if len(factors) > 1:
+        for R in factors[:-1]:
+            h = _guard_stage_taps(fs, R, f_keep)
+            stages.append((R, h))
+            guard_list.append((h, fs))
+            fs /= R
+    R_last = factors[-1] if factors else 1
+
+    def guard_resp(freqs):
+        resp = np.ones_like(np.asarray(freqs, np.float64))
+        for taps, fs_i in guard_list:
+            resp = resp * _stage_response(taps, fs_i, freqs)
+        return resp
+
+    h_last = _matched_last_stage(fs, corner, order, guard_resp, last_taps)
+    stages.append((R_last, h_last))
+
+    delay = 0
+    prod = 1
+    for R, h in stages:
+        delay += (len(h) // 2) * prod
+        prod *= R
+    assert prod == ratio
+    return CascadePlan(
+        stages=tuple((int(R), h) for R, h in stages),
+        ratio=int(ratio),
+        delay=int(delay),
+        fs_in=float(fs_in),
+        corner=float(corner),
+        order=int(order),
+    )
+
+
+# ---------------------------------------------------------------------------
+# application
+
+
+def _polyphase_stage_xla(x, hb, R, n_out):
+    """One causal decimating stage on (T, C) data via shifted matmuls:
+    ``y[k, c] = sum_j h[j] x[k*R + j, c]`` for k in [0, n_out).
+
+    hb is the (B, R) frame-blocked tap matrix (zero-padded taps).
+    """
+    import jax.numpy as jnp
+
+    B = hb.shape[0]
+    need = (n_out + B) * R
+    T = x.shape[0]
+    if need > T:
+        x = jnp.pad(x, ((0, need - T), (0, 0)))
+    xr = x[:need].reshape(n_out + B, R, x.shape[1])
+    y = jnp.zeros((n_out, x.shape[1]), x.dtype)
+    for b in range(B):
+        y = y + jnp.einsum("krc,r->kc", xr[b : b + n_out], hb[b])
+    return y
+
+
+def _block_taps(h: np.ndarray, R: int) -> np.ndarray:
+    L = len(h)
+    B = -(-L // R)
+    hp = np.zeros(B * R, np.float32)
+    hp[:L] = h
+    return hp.reshape(B, R)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_cascade_fn(plan: CascadePlan, n_out: int, engine: str):
+    """jit-compiled causal cascade: x (T, C) -> (n_out, C)."""
+    import jax
+    import jax.numpy as jnp
+
+    blocked = [
+        (R, jnp.asarray(_block_taps(np.asarray(h), R))) for R, h in plan.stages
+    ]
+    # required output count per stage, back to front: a stage producing
+    # n outputs with B tap-frames consumes (n + B) * R input samples
+    counts = [n_out]
+    for R, h in reversed(plan.stages[1:]):
+        counts.append((counts[-1] + (-(-len(h) // R))) * R)
+    counts.reverse()
+
+    use_pallas = engine == "pallas"
+    if use_pallas:
+        from tpudas.ops.pallas_fir import fir_decimate_pallas
+
+        # interpret mode off-TPU so the same code path is testable on
+        # the CPU mesh (SURVEY.md §4 "distributed-without-a-cluster")
+        interpret = jax.default_backend() not in ("tpu", "axon")
+
+    def fn(x):
+        x = x.astype(jnp.float32)
+        for (R, hb), k in zip(blocked, counts):
+            # Pallas only for stages that are both big enough to matter
+            # and whose taps fit the kernel's 128-frame block; very long
+            # single-stage plans (possible via the public design API)
+            # take the XLA polyphase path instead of erroring
+            if (
+                use_pallas
+                and k * R * x.shape[1] >= (1 << 21)
+                and hb.shape[0] <= 128
+            ):
+                x = fir_decimate_pallas(x, hb, R, n_out=k, interpret=interpret)
+            else:
+                x = _polyphase_stage_xla(x, hb, R, k)
+        return x
+
+    return jax.jit(fn)
+
+
+def cascade_decimate(x, plan: CascadePlan, phase: int, n_out: int, engine="auto"):
+    """Zero-phase filtered + decimated samples of ``x`` (T, C).
+
+    Output ``k`` equals the composite zero-phase filter of ``x``
+    evaluated at full-rate index ``phase + k*plan.ratio`` — exactly the
+    samples the reference's ``pass_filter → interpolate`` pipeline
+    (lf_das.py:223-225) lands on when the target grid is sample-aligned.
+    ``phase`` may be any non-negative int; edge regions (within
+    ``plan.delay`` of either end) carry the usual truncation artifacts,
+    which the overlap-save scheduler trims (SURVEY.md §3.1).
+    """
+    import jax.numpy as jnp
+
+    if engine == "auto":
+        import jax
+
+        engine = (
+            "pallas" if jax.default_backend() in ("tpu", "axon") else "xla"
+        )
+    x = jnp.asarray(x)
+    shift = int(phase) - plan.delay
+    if shift >= 0:
+        x2 = x[shift:]
+    else:
+        x2 = jnp.pad(x, ((-shift, 0), (0, 0)))
+    fn = _build_cascade_fn(plan, int(n_out), engine)
+    return fn(x2)
+
+
+# ---------------------------------------------------------------------------
+# probing (host-side, analytic)
+
+
+def impulse_response(plan: CascadePlan, n: int | None = None) -> np.ndarray:
+    """Composite full-rate impulse response of the cascade (numpy).
+
+    Equivalent to pushing a unit impulse through all stages WITHOUT
+    decimation (valid because decimation commutes with the linear
+    filters for response-support analysis) — the analytic counterpart of
+    the reference's synthetic-impulse probe (lf_das.py:47-87).
+    """
+    h = np.ones(1, np.float64)
+    prod = 1
+    for R, taps in plan.stages:
+        up = np.zeros(prod * (len(taps) - 1) + 1, np.float64)
+        up[::prod] = np.asarray(taps, np.float64)
+        h = np.convolve(h, up)
+        prod *= R
+    if n is not None and len(h) < n:
+        h = np.pad(h, (0, n - len(h)))
+    return h
+
+
+@functools.lru_cache(maxsize=256)
+def edge_support_samples(plan: CascadePlan, tol: float = 1e-3) -> int:
+    """One-sided support (full-rate samples) of the composite impulse
+    response thresholded at ``max*tol`` — the cascade's equivalent of
+    ``get_edge_effect_time`` (reference lf_das.py:67-77)."""
+    h = impulse_response(plan)
+    mag = np.abs(h)
+    above = np.nonzero(mag > mag.max() * tol)[0]
+    center = plan.delay
+    return int(max(center - above[0], above[-1] - center, 0))

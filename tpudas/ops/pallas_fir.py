@@ -1,0 +1,128 @@
+"""Pallas TPU kernel: strided (decimating) FIR along the time axis.
+
+This is the hot inner loop of the cascade engine (tpudas.ops.fir): for
+a (T, C) block and frame-blocked taps ``hb`` (B, R),
+
+    y[k, c] = sum_{b, r} hb[b, r] * x[(k + b) * R + r, c]
+
+i.e. a causal FIR of length <= B*R evaluated only at stride-R output
+positions — the op the reference executes as full-rate ``sosfiltfilt``
++ decimating ``interpolate`` (lf_das.py:223-225) and XLA executes as
+B shifted matmuls with B full HBM passes. The kernel reads each input
+element exactly once into VMEM and does all B shifted reductions
+on-chip.
+
+Layout: the input is viewed as frames ``(K + halo, R, C)`` (a free
+reshape — time-major data is already contiguous). The grid is
+``(K/KB, C/CB)``; each program gets its main frame block ``(KB, R, CB)``
+plus a ``(HALO_F, R, CB)`` halo block that is simply the head of the
+next main block, expressed as a second BlockSpec over the same array
+(possible because HALO_F divides KB, so the halo offset is an integer
+block index). Mosaic double-buffers both streams automatically.
+
+Tiling: KB=128 frames, CB=128 lanes (f32 min tile is (8, 128); R is
+the middle dim of the 3-D block). The tap table rides along as a
+(HALO_F, R) VMEM operand. VMEM per program at R=8:
+128*8*128*4B = 512 KB main + 32 KB halo + 64 KB out — comfortably
+inside the ~16 MB budget even with double buffering.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["fir_decimate_pallas"]
+
+_KB = 128  # output frames per program (sublane-aligned multiple of 8)
+_CB = 128  # channels per program (lane width)
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _kernel_body(B, KB, CB):
+    def kernel(hb_ref, xm_ref, xh_ref, out_ref):
+        full = jnp.concatenate([xm_ref[:], xh_ref[:]], axis=0)
+        acc = jnp.zeros((KB, CB), jnp.float32)
+        for b in range(B):
+            acc = acc + jnp.sum(
+                full[b : b + KB] * hb_ref[b][None, :, None], axis=1
+            )
+        out_ref[:] = acc
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit, static_argnames=("R", "n_out", "interpret", "kb", "cb")
+)
+def fir_decimate_pallas(
+    x, hb, R: int, n_out: int, interpret: bool = False, kb=_KB, cb=_CB
+):
+    """Strided FIR: x (T, C) f32, hb (B, R) f32 -> (n_out, C) f32.
+
+    ``n_out`` is static; the input is zero-padded on the right as
+    needed (outputs whose receptive field crosses the pad carry edge
+    artifacts, trimmed by the overlap-save caller). Falls back to
+    whole-block zero padding for channel counts that are not multiples
+    of the 128-lane tile.
+    """
+    B = int(hb.shape[0])
+    T, C = x.shape
+    KB, CB = int(kb), int(cb)
+    halo_f = _round_up(B, 8)
+    while halo_f <= KB and KB % halo_f != 0:
+        halo_f += 8
+    if halo_f > KB:
+        raise ValueError(
+            f"tap frames ({B}) exceed the kernel block ({KB} frames); "
+            "use the XLA polyphase path for very long stages"
+        )
+
+    nk = -(-int(n_out) // KB)
+    nc = -(-int(C) // CB)
+    Kpad = nk * KB
+    need_rows = (Kpad + halo_f) * R
+    pad_t = need_rows - T
+    pad_c = nc * CB - C
+    if pad_t > 0 or pad_c > 0:
+        x = jnp.pad(x, ((0, max(pad_t, 0)), (0, pad_c)))
+    xr = x[:need_rows].reshape(Kpad + halo_f, R, nc * CB)
+
+    hb_pad = jnp.zeros((halo_f, R), jnp.float32).at[:B].set(
+        hb.astype(jnp.float32)
+    )
+    step = KB // halo_f
+
+    out = pl.pallas_call(
+        _kernel_body(B, KB, CB),
+        grid=(nk, nc),
+        in_specs=[
+            pl.BlockSpec(
+                (halo_f, R), lambda k, c: (0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (KB, R, CB),
+                lambda k, c: (k, 0, c),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (halo_f, R, CB),
+                lambda k, c, _s=step: (k * _s + _s, 0, c),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (KB, CB), lambda k, c: (k, c), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((Kpad, nc * CB), jnp.float32),
+        interpret=interpret,
+    )(hb_pad, xr, xr)
+    return out[:n_out, :C]

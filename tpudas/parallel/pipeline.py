@@ -24,6 +24,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from tpudas.ops.fftlen import next_tpu_fft_len
+from tpudas.ops.filter import fft_lowpass_response
 from tpudas.parallel.halo import exchange_halo_time
 
 __all__ = ["sharded_lowpass_decimate"]
@@ -33,8 +34,7 @@ def _local_filter_decimate(padded, d_sec, corner, order, halo, t_local, ratio):
     """Filter a halo-padded local block, trim, stride-decimate."""
     nfft = next_tpu_fft_len(int(padded.shape[0]))
     spec = jnp.fft.rfft(padded, n=nfft, axis=0)
-    freqs = jnp.arange(nfft // 2 + 1, dtype=jnp.float32) / (nfft * d_sec)
-    resp = 1.0 / (1.0 + (freqs / corner) ** (2 * order))
+    resp = fft_lowpass_response(nfft, d_sec, corner, order)
     filt = jnp.fft.irfft(spec * resp[:, None], n=nfft, axis=0)
     interior = jax.lax.slice_in_dim(filt, halo, halo + t_local, axis=0)
     return interior[::ratio].astype(padded.dtype)

@@ -106,9 +106,10 @@ def _lowpass_resample_kernel(data, d_sec, corner, idx, w, nfft, order):
 
     data: (T, C) f32; idx/w: (K,) gather plan into the filtered rows.
     """
+    from tpudas.ops.filter import fft_lowpass_response
+
     spec = jnp.fft.rfft(data, n=nfft, axis=0)
-    freqs = jnp.arange(nfft // 2 + 1, dtype=jnp.float32) / (nfft * d_sec)
-    resp = 1.0 / (1.0 + (freqs / corner) ** (2 * order))
+    resp = fft_lowpass_response(nfft, d_sec, corner, order)
     filt = jnp.fft.irfft(spec * resp[:, None], n=nfft, axis=0)
     lo = jnp.take(filt, idx, axis=0)
     hi = jnp.take(filt, idx + 1, axis=0)
@@ -156,12 +157,23 @@ class LFProc:
             "data_gap_tolorance": 10.0,
             "on_gap": "raise",  # "raise" | "skip": split-at-gap policy
             "filter_order": 4,
+            # "auto": multistage polyphase FIR cascade (tpudas.ops.fir,
+            # Pallas on TPU) when the target grid is sample-aligned and
+            # the ratio factors; FFT engine otherwise. "fft"/"cascade"
+            # force one path.
+            "engine": "auto",
         }
+
+    _ENGINES = ("auto", "fft", "cascade")
 
     def update_processing_parameter(self, **kwargs):
         for key, value in kwargs.items():
             if key not in self._para:
                 print(f"{key} is not default parameter key")
+            elif key == "engine" and value not in self._ENGINES:
+                raise ValueError(
+                    f"engine must be one of {self._ENGINES}, got {value!r}"
+                )
             else:
                 self._para[key] = value
         return self.parameters
@@ -255,6 +267,39 @@ class LFProc:
             grid_points=len(time_grid),
         )
 
+    def _cascade_alignment(self, taxis, target_times, d_sec):
+        """If the (ms-quantized) target grid lands exactly on input
+        samples and the decimation ratio is a small-prime integer,
+        return (ratio, phase) for the cascade engine; else None.
+
+        The ratio is derived from the actual target-grid spacing (the
+        quantized step from build_time_grid), NOT the configured float
+        interval — the two differ when dt is not a whole ms.
+        """
+        if target_times.size < 2:
+            return None
+        t_ns = target_times.astype("datetime64[ns]").astype(np.int64)
+        step_ns = t_ns[1] - t_ns[0]
+        if step_ns <= 0 or np.any(np.diff(t_ns) != step_ns):
+            return None
+        dsec_ns = float(d_sec) * 1e9
+        ratio_f = step_ns / dsec_ns
+        ratio = int(round(ratio_f))
+        if ratio < 1 or abs(ratio_f - ratio) > 1e-6 * max(ratio, 1):
+            return None
+        t0 = taxis[0].astype("datetime64[ns]").astype(np.int64)
+        f0 = (t_ns[0] - t0) / dsec_ns
+        phase = int(round(f0))
+        if phase < 0 or abs(f0 - phase) > 1e-3:
+            return None
+        try:
+            from tpudas.ops.fir import factor_ratio
+
+            factor_ratio(ratio)
+        except ValueError:
+            return None
+        return ratio, phase
+
     def _process_window(self, window_patch, target_times, dt, corner, order):
         """Device side: fused filter+decimate, then write the interior."""
         if target_times.size == 0:
@@ -265,11 +310,63 @@ class LFProc:
             host = np.moveaxis(host, ax, 0)
         taxis = window_patch.coords["time"]
         d_sec = window_patch.get_sample_step("time")
-        idx, w = interp_indices_weights(taxis, target_times)
-        out = lowpass_resample(
-            host.astype(np.float32, copy=False), d_sec, corner, idx, w,
-            order=order,
-        )
+        engine = self._para.get("engine", "auto")
+        if engine not in self._ENGINES:
+            raise ValueError(
+                f"engine must be one of {self._ENGINES}, got {engine!r}"
+            )
+        align = None
+        if engine in ("auto", "cascade"):
+            align = self._cascade_alignment(taxis, target_times, d_sec)
+            if align is None and engine == "cascade":
+                raise ValueError(
+                    "engine='cascade' requires the output grid to land on "
+                    "input samples with an integer small-prime decimation "
+                    "ratio; use engine='auto' or 'fft'"
+                )
+        if align is not None:
+            from tpudas.ops.fir import (
+                cascade_decimate,
+                design_cascade,
+                edge_support_samples,
+            )
+
+            ratio, phase = align
+            plan = design_cascade(1.0 / d_sec, ratio, corner, int(order))
+            # the edge halo must cover the cascade's (tol-thresholded)
+            # filter support on both sides, or the emitted interior
+            # carries edge artifacts — same contract the reference's
+            # probe enforces for the buffer (lf_das.py:79-85)
+            supp = edge_support_samples(plan, 1e-3)
+            tail = host.shape[0] - (phase + (target_times.size - 1) * ratio)
+            if supp > phase or supp > tail:
+                log_event(
+                    "cascade_halo_too_small",
+                    support=supp,
+                    phase=phase,
+                    tail=int(tail),
+                )
+                if engine == "cascade":
+                    print(
+                        "Warning: edge_buff_size halo is smaller than the "
+                        f"cascade filter support ({supp} input samples); "
+                        "emitted edges may carry artifacts"
+                    )
+                else:
+                    align = None  # auto: fall back to the FFT engine
+        if align is not None:
+            out = cascade_decimate(
+                host.astype(np.float32, copy=False),
+                plan,
+                phase,
+                int(target_times.size),
+            )
+        else:
+            idx, w = interp_indices_weights(taxis, target_times)
+            out = lowpass_resample(
+                host.astype(np.float32, copy=False), d_sec, corner, idx, w,
+                order=order,
+            )
         out = np.asarray(out)
         if ax != 0:
             out = np.moveaxis(out, 0, ax)

@@ -89,21 +89,35 @@ def run_lowpass_realtime(
             print("run number: ", rounds)
             if initial_run:
                 t1 = start_time
-                initial_run = False
             else:
-                t_last = lfp.get_last_processed_time()
-                # rewind (ceil(edge/dt) - 1) output steps, exactly on the
-                # output grid — ns precision so fractional d_t stays
-                # seam-free (the resumed run's first emitted sample is
-                # then t_last + d_t)
-                rewind_sec = (math.ceil(edge_buffer / d_t) - 1) * d_t
-                t1 = t_last - to_timedelta64(rewind_sec)
+                try:
+                    t_last = lfp.get_last_processed_time()
+                except IndexError:
+                    # a prior round completed without emitting output
+                    # (stream still shorter than the edge trim) — no
+                    # checkpoint yet, retry from the very start
+                    t_last = None
+                if t_last is None:
+                    t1 = start_time
+                else:
+                    # rewind (ceil(edge/dt) - 1) output steps, exactly
+                    # on the output grid — ns precision so fractional
+                    # d_t stays seam-free (the resumed run's first
+                    # emitted sample is then t_last + d_t)
+                    rewind_sec = (math.ceil(edge_buffer / d_t) - 1) * d_t
+                    t1 = t_last - to_timedelta64(rewind_sec)
             # newest timestamp from the index — no file data is read
             t2 = np.datetime64(sub.get_contents()["time_max"].max())
             lfp.process_time_range(t1, t2)
             log_event("realtime_round", round=rounds, upto=str(t2))
             if on_round is not None:
                 on_round(rounds, lfp)
+            len_last = n_now
+        # an empty first poll still counts as "seen": the next empty
+        # poll must terminate (reference semantics — the loop ends when
+        # the spool stops growing, low_pass_dascore_edge.ipynb:205-207)
+        initial_run = False
+        if len_last is None:
             len_last = n_now
         if max_rounds is not None and polls >= max_rounds:
             break
