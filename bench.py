@@ -3,19 +3,27 @@ low-pass + decimate pipeline on one TPU chip.
 
 Workload (BASELINE.md config 4 scaled to one chip): overlap-save
 windows of a 1 kHz interrogator stream, C channels x T samples float32
-per window, fused rfft → Butterworth² response → irfft → gather
-decimation to 1 Hz — the per-window inner loop of
+per window, zero-phase low-pass at 0.45x the post-decimation Nyquist +
+1000x decimation to 1 Hz — the per-window inner loop of
 ``LFProc.process_time_range`` (SURVEY.md §3.1 hot loop #1).
+
+Engines (BENCH_ENGINE):
+  cascade  (default) multistage polyphase FIR, response-matched to the
+           Butterworth-squared reference filter (tpudas.ops.fir);
+           BENCH_PALLAS=1 uses the Pallas strided-FIR kernel for the
+           big stages, 0 the XLA polyphase formulation
+  fft      the rfft -> response multiply -> irfft -> gather engine
+           (tpudas.proc.lfproc), kept as the parity baseline
 
 Windows are generated on device each iteration (fresh PRNG key per
 window, so XLA cannot cache across iterations) and results are reduced
 on device with one final host fetch forcing the full execution chain.
-Host→device ingest is EXCLUDED by default: this dev environment reaches
-the TPU through a tunnel whose measured H2D bandwidth is ~30 MB/s — an
-artifact three orders of magnitude below the PCIe/NVMe ingest of a real
-edge deployment — and including it benchmarks the tunnel, not the
-framework. Set BENCH_INCLUDE_H2D=1 to measure the tunnel-fed path
-anyway.
+Host->device ingest is EXCLUDED by default: this dev environment
+reaches the TPU through a tunnel whose measured H2D bandwidth is
+~30 MB/s — an artifact three orders of magnitude below the PCIe/NVMe
+ingest of a real edge deployment — and including it benchmarks the
+tunnel, not the framework. Set BENCH_INCLUDE_H2D=1 to measure the
+tunnel-fed path anyway.
 
 Prints ONE JSON line:
   metric       channel_samples_per_sec
@@ -25,7 +33,8 @@ Prints ONE JSON line:
                channel-samples/sec, targeted for a v5e-8); >1.0 means
                this single chip alone beats the 8-chip target.
 
-Env knobs: BENCH_T, BENCH_C, BENCH_ITERS, BENCH_INCLUDE_H2D=0/1.
+Env knobs: BENCH_T, BENCH_C, BENCH_ITERS, BENCH_ENGINE,
+BENCH_PALLAS=0/1, BENCH_INCLUDE_H2D=0/1.
 """
 
 from __future__ import annotations
@@ -37,38 +46,67 @@ import time
 import numpy as np
 
 
-def main():
+def _build_fft_step(T, C, fs, dt_out, order):
     import jax
     import jax.numpy as jnp
 
     from tpudas.ops.fftlen import next_tpu_fft_len
     from tpudas.proc.lfproc import _lowpass_resample_kernel
 
-    T = int(os.environ.get("BENCH_T", 131072))  # ~131 s @ 1 kHz
-    C = int(os.environ.get("BENCH_C", 2048))
-    iters = int(os.environ.get("BENCH_ITERS", 16))
-    include_h2d = os.environ.get("BENCH_INCLUDE_H2D", "0") == "1"
-
-    fs = 1000.0
-    d_sec = 1.0 / fs
-    dt_out = 1.0  # 1 Hz output
     corner = 1.0 / dt_out / 2.0 * 0.9
     ratio = int(round(dt_out * fs))
     nfft = next_tpu_fft_len(T)
-
     idx = jnp.asarray(np.arange(0, T - 1, ratio), jnp.int32)
     w = jnp.zeros((idx.shape[0],), jnp.float32)
 
     def kernel(data):
         return _lowpass_resample_kernel(
-            data, jnp.float32(d_sec), jnp.float32(corner), idx, w, nfft, 4
+            data, jnp.float32(1.0 / fs), jnp.float32(corner), idx, w, nfft,
+            order,
         )
+
+    return kernel
+
+
+def _build_cascade_step(T, C, fs, dt_out, order, use_pallas):
+    from tpudas.ops.fir import _build_cascade_fn, design_cascade
+
+    corner = 1.0 / dt_out / 2.0 * 0.9
+    ratio = int(round(dt_out * fs))
+    plan = design_cascade(fs, ratio, corner, order)
+    # steady-state window phase: the engine's halo is edge_buff_size
+    # output samples; emitted sample 0 sits ratio*buff inside the
+    # window. delay alignment is free (slice), included in the timing.
+    n_out = T // ratio
+    fn = _build_cascade_fn(plan, n_out, "pallas" if use_pallas else "xla")
+
+    def kernel(data):
+        return fn(data)
+
+    return kernel
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    T = int(os.environ.get("BENCH_T", 131072))  # ~131 s @ 1 kHz
+    C = int(os.environ.get("BENCH_C", 2048))
+    iters = int(os.environ.get("BENCH_ITERS", 16))
+    engine = os.environ.get("BENCH_ENGINE", "cascade")
+    use_pallas = os.environ.get("BENCH_PALLAS", "0") == "1"
+    include_h2d = os.environ.get("BENCH_INCLUDE_H2D", "0") == "1"
+
+    fs, dt_out, order = 1000.0, 1.0, 4
+    if engine == "cascade":
+        kernel = _build_cascade_step(T, C, fs, dt_out, order, use_pallas)
+    else:
+        kernel = _build_fft_step(T, C, fs, dt_out, order)
 
     if include_h2d:
         host_window = (
             np.random.default_rng(0).standard_normal((T, C)).astype(np.float32)
         )
-        # warm-up (compile + first transfers), forced via device_get
         jax.device_get(kernel(jnp.asarray(host_window)))
         t0 = time.perf_counter()
         for _ in range(iters):
@@ -76,13 +114,10 @@ def main():
         elapsed = time.perf_counter() - t0
         assert np.isfinite(out).all()
     else:
-        gen = jax.jit(
-            lambda key: jax.random.normal(key, (T, C), jnp.float32)
-        )
+        gen = jax.jit(lambda key: jax.random.normal(key, (T, C), jnp.float32))
         step = jax.jit(lambda key: jnp.sum(jnp.abs(kernel(gen(key)))))
         root = jax.random.PRNGKey(0)
-        # warm-up: compile gen + kernel, force real completion
-        float(step(jax.random.fold_in(root, 10**6)))
+        float(step(jax.random.fold_in(root, 10**6)))  # compile + settle
         t0 = time.perf_counter()
         total = jnp.zeros((), jnp.float32)
         for i in range(iters):

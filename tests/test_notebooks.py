@@ -1,0 +1,43 @@
+"""The four workflow notebooks execute end-to-end (the reference's
+de-facto integration-test strategy — notebooks ARE the tests,
+SURVEY.md §4.1). Cells run unmodified in-process on small synthetic
+configs injected via TPUDAS_NB_* env knobs."""
+
+import json
+import os
+
+import pytest
+
+NB_DIR = os.path.join(os.path.dirname(__file__), os.pardir, "notebooks")
+
+NOTEBOOKS = [
+    "low_pass_tpudas.ipynb",
+    "rolling_mean_tpudas.ipynb",
+    "low_pass_tpudas_edge.ipynb",
+    "rolling_mean_tpudas_edge.ipynb",
+]
+
+
+def _code_cells(path):
+    with open(path) as f:
+        nb = json.load(f)
+    for cell in nb["cells"]:
+        if cell["cell_type"] == "code":
+            yield "".join(cell["source"])
+
+
+@pytest.mark.parametrize("name", NOTEBOOKS)
+def test_notebook_executes(name, tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUDAS_NB_WORKDIR", str(tmp_path / "wd"))
+    monkeypatch.setenv("TPUDAS_NB_NCH", "8")
+    monkeypatch.setenv("TPUDAS_NB_FS", "100.0")
+    monkeypatch.setenv("TPUDAS_NB_POLL", "0.5")
+    ns = {"__name__": "__main__"}
+    for i, src in enumerate(_code_cells(os.path.join(NB_DIR, name))):
+        try:
+            exec(compile(src, f"{name}:cell{i}", "exec"), ns)
+        except Exception as e:  # pragma: no cover - diagnostic
+            pytest.fail(f"{name} cell {i} failed: {e}\n---\n{src[:800]}")
+    import matplotlib.pyplot as plt
+
+    plt.close("all")

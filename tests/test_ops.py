@@ -200,3 +200,20 @@ class TestMedian:
         a = p.median_filter(size=5, dim="time")
         b = p.median_filter(size=5, dim="time", engine="scipy")
         assert np.allclose(a.host_data(), b.host_data(), atol=1e-6)
+
+
+class TestMedianTupleSize:
+    def test_per_axis_footprint_matches_scipy(self):
+        import scipy.ndimage
+
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((40, 6)).astype(np.float32)
+        ours = np.asarray(median_filter(x, (3, 1)))
+        ref = scipy.ndimage.median_filter(x, size=(3, 1))
+        assert np.abs(ours - ref).max() < 1e-6
+
+    def test_even_size_rejected(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="odd"):
+            median_filter(np.zeros((8, 4), np.float32), (2, 1))

@@ -29,17 +29,17 @@ def _reflect_pad_1d(arr, pad, axis):
     return jnp.concatenate([front, arr, back], axis=axis)
 
 
-@functools.partial(jax.jit, static_argnames=("size", "axes"))
-def _median_kernel(data, size, axes):
-    pad = size // 2
+@functools.partial(jax.jit, static_argnames=("sizes", "axes"))
+def _median_kernel(data, sizes, axes):
     padded = data
-    for ax in axes:
-        padded = _reflect_pad_1d(padded, pad, ax)
+    for ax, sz in zip(axes, sizes):
+        if sz > 1:
+            padded = _reflect_pad_1d(padded, sz // 2, ax)
     views = []
-    # gather all size**len(axes) shifted views
+    # gather all prod(sizes) shifted views
     shifts = [()]
-    for _ in axes:
-        shifts = [sh + (k,) for sh in shifts for k in range(size)]
+    for sz in sizes:
+        shifts = [sh + (k,) for sh in shifts for k in range(sz)]
     n_out = data.shape
     for sh in shifts:
         view = padded
@@ -51,15 +51,28 @@ def _median_kernel(data, size, axes):
 
 
 def median_filter(data, size, axes=None):
-    """Median filter with an odd ``size`` footprint along ``axes``
-    (default: all axes, matching ``scipy.ndimage.median_filter(x, size)``).
+    """Median filter along ``axes`` (default all), matching
+    ``scipy.ndimage.median_filter(x, size)`` semantics: ``size`` is a
+    single odd footprint or a per-axis tuple (1 = no filtering on that
+    axis, e.g. ``(3, 1)`` despikes along time only on a (T, C) array).
     """
-    if size % 2 != 1:
-        raise ValueError("median filter size must be odd")
     arr = jnp.asarray(data)
     if axes is None:
         axes = tuple(range(arr.ndim))
-    return _median_kernel(arr, int(size), tuple(int(a) for a in axes))
+    axes = tuple(int(a) for a in axes)
+    if np.isscalar(size):
+        sizes = (int(size),) * len(axes)
+    else:
+        sizes = tuple(int(s) for s in size)
+        if len(sizes) != len(axes):
+            raise ValueError(
+                f"size tuple {sizes} must have one entry per filtered "
+                f"axis ({len(axes)})"
+            )
+    for sz in sizes:
+        if sz % 2 != 1:
+            raise ValueError("median filter sizes must be odd")
+    return _median_kernel(arr, sizes, axes)
 
 
 def patch_median_filter(patch, size=5, dim=None, engine=None):

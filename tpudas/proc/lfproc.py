@@ -338,8 +338,10 @@ class LFProc:
             # carries edge artifacts — same contract the reference's
             # probe enforces for the buffer (lf_das.py:79-85)
             supp = edge_support_samples(plan, 1e-3)
+            # samples strictly after the last emitted output's index:
+            # its support needs i_last + supp <= T-1, i.e. supp < tail
             tail = host.shape[0] - (phase + (target_times.size - 1) * ratio)
-            if supp > phase or supp > tail:
+            if supp > phase or supp >= tail:
                 log_event(
                     "cascade_halo_too_small",
                     support=supp,

@@ -65,16 +65,18 @@ def run_lowpass_realtime(
     interval = clamp_poll_interval(poll_interval, file_duration, edge_buffer)
     start_time = to_datetime64(start_time)
 
-    initial_run = True
+    processed_once = False  # first PROCESSING round always starts at
+    # start_time, however many empty polls precede it (a pre-existing
+    # output folder must not hijack the user's start point)
     rounds = 0
     polls = 0
-    len_last = None
+    len_last = None  # spool size at the previous poll (None = no poll yet)
     while True:
         polls += 1
         sp = make_spool(source).update()
         sub = sp.select(distance=distance) if distance is not None else sp
         n_now = len(sub)
-        if not initial_run and n_now == len_last:
+        if len_last is not None and n_now == len_last:
             print("No new data was detected. Real-time processing ended successfully.")
             break
         if n_now > 0:
@@ -87,7 +89,7 @@ def run_lowpass_realtime(
             lfp.set_output_folder(output_folder, delete_existing=False)
             rounds += 1
             print("run number: ", rounds)
-            if initial_run:
+            if not processed_once:
                 t1 = start_time
             else:
                 try:
@@ -112,13 +114,12 @@ def run_lowpass_realtime(
             log_event("realtime_round", round=rounds, upto=str(t2))
             if on_round is not None:
                 on_round(rounds, lfp)
-            len_last = n_now
-        # an empty first poll still counts as "seen": the next empty
-        # poll must terminate (reference semantics — the loop ends when
-        # the spool stops growing, low_pass_dascore_edge.ipynb:205-207)
-        initial_run = False
-        if len_last is None:
-            len_last = n_now
+            processed_once = True
+        # every poll (including an empty first one) sets the growth
+        # baseline: the next no-growth poll terminates (reference
+        # semantics — the loop ends when the spool stops growing,
+        # low_pass_dascore_edge.ipynb:205-207)
+        len_last = n_now
         if max_rounds is not None and polls >= max_rounds:
             break
         sleep_fn(interval)
