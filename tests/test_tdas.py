@@ -1,0 +1,169 @@
+"""Native tdas stream format + C++ streamio runtime: roundtrips,
+range reads, int16 quantization, native/numpy parity, window assembly,
+and the full engine running on a tdas spool."""
+
+import os
+
+import numpy as np
+import pytest
+
+from tpudas import spool
+from tpudas.io import tdas
+from tpudas.io.registry import read_file, scan_file, write_patch
+from tpudas.testing import make_synthetic_spool, synthetic_patch
+
+
+@pytest.fixture()
+def patch():
+    return synthetic_patch(duration=10.0, fs=100.0, n_ch=12, noise=0.05)
+
+
+class TestRoundtrip:
+    def test_float32_exact(self, patch, tmp_path):
+        path = str(tmp_path / "a.tdas")
+        write_patch(patch, path, format="tdas")
+        (back,) = read_file(path, format="tdas")
+        assert np.array_equal(back.host_data(), patch.host_data())
+        assert np.array_equal(back.coords["time"], patch.coords["time"])
+        assert np.allclose(back.coords["distance"], patch.coords["distance"])
+
+    def test_int16_quantized(self, patch, tmp_path):
+        path = str(tmp_path / "a.tdas")
+        write_patch(patch, path, format="tdas", dtype="int16")
+        (back,) = read_file(path, format="tdas")
+        hdr = tdas.read_tdas_header(path)
+        assert hdr["dtype_code"] == 1
+        # quantization error bounded by half an LSB
+        err = np.abs(back.host_data() - patch.host_data()).max()
+        assert err <= hdr["scale"] * 0.5 + 1e-7
+        # int16 payload is half the size of the float32 one
+        p32 = str(tmp_path / "b.tdas")
+        write_patch(patch, p32, format="tdas")
+        assert os.path.getsize(path) < 0.6 * os.path.getsize(p32)
+
+    def test_nonuniform_time_rejected(self, patch, tmp_path):
+        coords = dict(patch.coords)
+        t = coords["time"].copy()
+        t[3] += np.timedelta64(1, "ms")
+        coords["time"] = t
+        bad = patch.new(coords=coords)
+        with pytest.raises(ValueError, match="uniform time"):
+            write_patch(bad, str(tmp_path / "x.tdas"), format="tdas")
+
+
+class TestRangeReads:
+    def test_time_range_matches_slice(self, patch, tmp_path):
+        path = str(tmp_path / "a.tdas")
+        write_patch(patch, path, format="tdas")
+        t = patch.coords["time"]
+        (sub,) = read_file(path, format="tdas", time=(t[100], t[399]))
+        full = patch.host_data()
+        assert sub.host_data().shape == (300, 12)
+        assert np.array_equal(sub.host_data(), full[100:400])
+        assert sub.coords["time"][0] == t[100]
+
+    def test_distance_range(self, patch, tmp_path):
+        path = str(tmp_path / "a.tdas")
+        write_patch(patch, path, format="tdas")
+        d = patch.coords["distance"]
+        (sub,) = read_file(path, format="tdas", distance=(d[3], d[7]))
+        assert sub.host_data().shape[1] == 5
+        assert np.array_equal(sub.host_data(), patch.host_data()[:, 3:8])
+
+    def test_block_out_of_bounds(self, patch, tmp_path):
+        path = str(tmp_path / "a.tdas")
+        write_patch(patch, path, format="tdas")
+        with pytest.raises(ValueError, match="out of bounds"):
+            tdas.read_tdas_block(path, 0, 10**6, 0, 1)
+
+
+class TestNativeParity:
+    def test_numpy_fallback_identical(self, patch, tmp_path, monkeypatch):
+        path = str(tmp_path / "a.tdas")
+        write_patch(patch, path, format="tdas", dtype="int16")
+        native = tdas.read_tdas_block(path, 50, 750, 2, 11)
+        monkeypatch.setattr(tdas, "load_streamio", lambda: None)
+        fallback = tdas.read_tdas_block(path, 50, 750, 2, 11)
+        assert np.array_equal(native, fallback)
+
+    def test_write_fallback_readable_by_native(self, patch, tmp_path,
+                                               monkeypatch):
+        path = str(tmp_path / "a.tdas")
+        monkeypatch.setattr(tdas, "load_streamio", lambda: None)
+        tdas.write_tdas(patch, path)
+        monkeypatch.undo()
+        (back,) = read_file(path, format="tdas")
+        assert np.array_equal(back.host_data(), patch.host_data())
+
+
+class TestAssembleWindow:
+    def test_multi_file_window(self, tmp_path):
+        paths = make_synthetic_spool(
+            tmp_path, n_files=3, file_duration=10.0, fs=100.0, n_ch=8,
+            noise=0.05, format="tdas",
+        )
+        # window spanning the tail of file 0, all of file 1, head of 2
+        segs = [
+            (paths[0], 600, 1000, 0),
+            (paths[1], 0, 1000, 400),
+            (paths[2], 0, 300, 1400),
+        ]
+        win = tdas.assemble_window(segs, 1, 7, 1700)
+        assert win.shape == (1700, 6)
+        a = tdas.read_tdas_block(paths[0], 600, 1000, 1, 7)
+        b = tdas.read_tdas_block(paths[1], 0, 1000, 1, 7)
+        c = tdas.read_tdas_block(paths[2], 0, 300, 1, 7)
+        assert np.array_equal(win, np.concatenate([a, b, c]))
+
+
+class TestSpoolIntegration:
+    def test_index_scan_and_select(self, tmp_path):
+        make_synthetic_spool(
+            tmp_path, n_files=4, file_duration=15.0, fs=50.0, n_ch=6,
+            format="tdas",
+        )
+        sp = spool(str(tmp_path)).sort("time").update()
+        assert len(sp) == 4
+        df = sp.get_contents()
+        assert set(df["format"]) == {"tdas"}
+        merged = sp.chunk(time=None)
+        assert len(merged) == 1
+        assert merged[0].host_data().shape == (4 * 750, 6)
+
+    def test_corrupt_file_skipped(self, tmp_path):
+        make_synthetic_spool(
+            tmp_path, n_files=2, file_duration=15.0, fs=50.0, n_ch=6,
+            format="tdas",
+        )
+        with open(tmp_path / "junk.tdas", "wb") as fh:
+            fh.write(b"not a tdas file at all")
+        sp = spool(str(tmp_path)).update()
+        assert len(sp) == 2
+
+    def test_lfproc_end_to_end_on_tdas(self, tmp_path):
+        """The full chunked engine runs unchanged on a native-format
+        spool and matches the dasdae-format result exactly."""
+        from tpudas.proc.lfproc import LFProc
+
+        results = {}
+        for fmt in ("tdas", "dasdae"):
+            src = tmp_path / fmt
+            make_synthetic_spool(
+                src, n_files=4, file_duration=30.0, fs=100.0, n_ch=6,
+                noise=0.01, format=fmt,
+            )
+            lfp = LFProc(spool(str(src)).sort("time").update())
+            lfp.update_processing_parameter(
+                output_sample_interval=1.0, process_patch_size=50,
+                edge_buff_size=10,
+            )
+            out = tmp_path / (fmt + "_out")
+            lfp.set_output_folder(str(out), delete_existing=True)
+            lfp.process_time_range(
+                np.datetime64("2023-03-22T00:00:00"),
+                np.datetime64("2023-03-22T00:02:00"),
+            )
+            results[fmt] = spool(str(out)).update().chunk(time=None)[0]
+        assert np.array_equal(
+            results["tdas"].host_data(), results["dasdae"].host_data()
+        )

@@ -22,7 +22,8 @@ import numpy as np
 import pandas as pd
 
 INDEX_FILENAME = ".tpudas_index.json"
-_SUFFIXES = (".h5", ".hdf5")
+_SUFFIXES = (".h5", ".hdf5", ".tdas")
+_FORMAT_BY_SUFFIX = {".h5": "dasdae", ".hdf5": "dasdae", ".tdas": "tdas"}
 
 _COLUMNS = [
     "path",
@@ -134,8 +135,9 @@ class DirectoryIndex:
                 "size"
             ) == st.st_size:
                 continue
+            fmt = _FORMAT_BY_SUFFIX[os.path.splitext(name.lower())[1]]
             try:
-                info = scan_file(path)[0]
+                info = scan_file(path, format=fmt)[0]
             except (OSError, ValueError):
                 continue  # unreadable / foreign / partially-written file
             info["mtime"] = st.st_mtime

@@ -8,10 +8,11 @@ given.
 
 from __future__ import annotations
 
-from tpudas.io import dasdae
+from tpudas.io import dasdae, tdas
 
 _FORMATS = {
     "dasdae": (dasdae.read_dasdae, dasdae.write_dasdae, dasdae.scan_dasdae),
+    "tdas": (tdas.read_tdas, tdas.write_tdas, tdas.scan_tdas),
 }
 
 

@@ -1,0 +1,302 @@
+"""``tdas``: flat binary stream format for the real-time ingest path.
+
+Where the reference funnels all interrogator output through HDF5
+(``patch.io.write(path, "dasdae")``, lf_das.py:232), tdas is the
+edge-deployment alternative this framework adds: a 64-byte header + a
+row-major (time, channel) payload (float32, or int16 with a scale for
+2x ingest bandwidth). Range reads are exact byte offsets — no chunk
+B-trees — executed by the threaded C++ runtime
+(tpudas/native/streamio.cpp) when available, with a numpy fallback of
+identical semantics.
+
+The format registers in the IO registry, so spools index and read
+``*.tdas`` interrogator directories exactly like dasdae ones, and the
+whole engine (LFProc, streaming loops) runs on them unchanged.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+
+import numpy as np
+
+from tpudas.core.patch import Patch
+from tpudas.core.timeutils import to_datetime64
+from tpudas.native import load_streamio
+
+FORMAT_NAME = "tdas"
+_MAGIC = b"TDAS"
+_HEADER = struct.Struct("<4sIQQIIIfddQ")  # 64 bytes
+_HEADER_SIZE = 64
+_DTYPES = {0: np.float32, 1: np.int16}
+
+
+def _default_threads() -> int:
+    n = os.cpu_count() or 1
+    return max(1, min(8, n - 1))
+
+
+def _pack_header(t0_ns, dt_ns, n_time, n_ch, dtype_code, scale, d0, dx):
+    return _HEADER.pack(
+        _MAGIC, 1, t0_ns, dt_ns, n_time, n_ch, dtype_code, scale, d0, dx, 0
+    )
+
+
+def _unpack_header(raw: bytes) -> dict:
+    magic, version, t0_ns, dt_ns, n_time, n_ch, dtype_code, scale, d0, dx, _ = (
+        _HEADER.unpack(raw)
+    )
+    if magic != _MAGIC:
+        raise ValueError("not a tdas file (bad magic)")
+    if version != 1:
+        raise ValueError(f"unsupported tdas version {version}")
+    return dict(
+        t0_ns=t0_ns,
+        dt_ns=dt_ns,
+        n_time=n_time,
+        n_ch=n_ch,
+        dtype_code=dtype_code,
+        scale=scale,
+        d0=d0,
+        dx=dx,
+    )
+
+
+def read_tdas_header(path) -> dict:
+    with open(path, "rb") as fh:
+        raw = fh.read(_HEADER_SIZE)
+    if len(raw) != _HEADER_SIZE:
+        raise ValueError("truncated tdas header")
+    return _unpack_header(raw)
+
+
+# ---------------------------------------------------------------------------
+# write
+
+
+def write_tdas(patch, path, dtype="float32", scale=None, **_):
+    """Write a 2-D (time, distance) Patch. ``dtype="int16"`` quantizes
+    by ``scale`` (default: max|x|/32000, stored in the header)."""
+    taxis = np.asarray(patch.coords["time"]).astype("datetime64[ns]")
+    if taxis.size < 2:
+        raise ValueError("tdas requires >= 2 time samples")
+    steps = np.diff(taxis.astype(np.int64))
+    if not np.all(steps == steps[0]):
+        raise ValueError("tdas requires a uniform time axis")
+    dist = np.asarray(patch.coords["distance"], np.float64)
+    dx = float(dist[1] - dist[0]) if dist.size > 1 else 0.0
+    if dist.size > 2 and not np.allclose(np.diff(dist), dx):
+        raise ValueError("tdas requires a uniform distance axis")
+
+    data = np.asarray(patch.host_data())
+    ax = patch.axis_of("time")
+    if ax != 0:
+        data = np.moveaxis(data, ax, 0)
+    data = np.ascontiguousarray(data, np.float32)
+
+    if dtype == "int16":
+        code = 1
+        if scale is None:
+            peak = float(np.abs(data).max()) or 1.0
+            scale = peak / 32000.0
+        payload = np.clip(
+            np.round(data / scale), -32768, 32767
+        ).astype(np.int16)
+    elif dtype == "float32":
+        code = 0
+        scale = 1.0
+        payload = data
+    else:
+        raise ValueError(f"tdas dtype must be float32|int16, got {dtype!r}")
+
+    t0_ns = int(taxis[0].astype(np.int64))
+    dt_ns = int(steps[0])
+    lib = load_streamio()
+    if lib is not None:
+        rc = lib.tdas_write(
+            os.fsencode(path),
+            t0_ns,
+            dt_ns,
+            data.shape[0],
+            data.shape[1],
+            code,
+            float(scale),
+            float(dist[0]) if dist.size else 0.0,
+            dx,
+            payload.ctypes.data_as(ctypes.c_void_p),
+        )
+        if rc != 0:
+            raise OSError(rc, f"tdas_write failed for {path}")
+    else:
+        with open(path, "wb") as fh:
+            fh.write(
+                _pack_header(
+                    t0_ns, dt_ns, data.shape[0], data.shape[1], code,
+                    float(scale), float(dist[0]) if dist.size else 0.0, dx,
+                )
+            )
+            fh.write(payload.tobytes())
+    return path
+
+
+# ---------------------------------------------------------------------------
+# read / scan
+
+
+def _row_range(hdr, time):
+    """[lo, hi) row range selected by a (t_lo, t_hi) datetime window —
+    inclusive bounds, matching Patch.select semantics."""
+    n = hdr["n_time"]
+    lo, hi = 0, n
+    if time is not None:
+        t_lo, t_hi = time
+        if t_lo is not None:
+            t = to_datetime64(t_lo).astype("datetime64[ns]").astype(np.int64)
+            lo = max(
+                0, int(np.ceil((t - hdr["t0_ns"]) / hdr["dt_ns"]))
+            )
+        if t_hi is not None:
+            t = to_datetime64(t_hi).astype("datetime64[ns]").astype(np.int64)
+            hi = min(
+                n, int(np.floor((t - hdr["t0_ns"]) / hdr["dt_ns"])) + 1
+            )
+    return lo, max(lo, hi)
+
+
+def _ch_range(hdr, distance):
+    n = hdr["n_ch"]
+    lo, hi = 0, n
+    if distance is not None and hdr["dx"] != 0:
+        d_lo, d_hi = distance
+        if d_lo is not None:
+            lo = max(0, int(np.ceil((float(d_lo) - hdr["d0"]) / hdr["dx"])))
+        if d_hi is not None:
+            hi = min(
+                n, int(np.floor((float(d_hi) - hdr["d0"]) / hdr["dx"])) + 1
+            )
+    return lo, max(lo, hi)
+
+
+def _read_block_numpy(path, hdr, t_lo, t_hi, c_lo, c_hi):
+    dt = _DTYPES[hdr["dtype_code"]]
+    es = dt().itemsize
+    n_ch = hdr["n_ch"]
+    rows = t_hi - t_lo
+    with open(path, "rb") as fh:
+        fh.seek(_HEADER_SIZE + t_lo * n_ch * es)
+        raw = np.fromfile(fh, dtype=dt, count=rows * n_ch)
+    raw = raw.reshape(rows, n_ch)[:, c_lo:c_hi]
+    if hdr["dtype_code"] == 1:
+        return raw.astype(np.float32) * np.float32(hdr["scale"])
+    return np.ascontiguousarray(raw, np.float32)
+
+
+def read_tdas_block(path, t_lo, t_hi, c_lo, c_hi, n_threads=None):
+    """(t_hi-t_lo, c_hi-c_lo) float32 block; native threaded reader
+    when available."""
+    hdr = read_tdas_header(path)
+    if not (0 <= t_lo <= t_hi <= hdr["n_time"]):
+        raise ValueError(f"row range [{t_lo}, {t_hi}) out of bounds")
+    if not (0 <= c_lo <= c_hi <= hdr["n_ch"]):
+        raise ValueError(f"channel range [{c_lo}, {c_hi}) out of bounds")
+    lib = load_streamio()
+    if lib is None:
+        return _read_block_numpy(path, hdr, t_lo, t_hi, c_lo, c_hi)
+    out = np.empty((t_hi - t_lo, c_hi - c_lo), np.float32)
+    rc = lib.tdas_read_block(
+        os.fsencode(path),
+        int(t_lo),
+        int(t_hi),
+        int(c_lo),
+        int(c_hi),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        int(n_threads or _default_threads()),
+    )
+    if rc != 0:
+        raise OSError(rc, f"tdas_read_block failed for {path}")
+    return out
+
+
+def _patch_from_block(hdr, block, t_lo, c_lo):
+    t0 = np.datetime64(hdr["t0_ns"] + t_lo * hdr["dt_ns"], "ns")
+    taxis = t0 + np.arange(block.shape[0]) * np.timedelta64(
+        hdr["dt_ns"], "ns"
+    )
+    dist = hdr["d0"] + (c_lo + np.arange(block.shape[1])) * hdr["dx"]
+    return Patch(
+        data=block,
+        coords={"time": taxis, "distance": dist},
+        dims=("time", "distance"),
+    )
+
+
+def read_tdas(path, time=None, distance=None, **_):
+    """Read (a range of) a tdas file -> [Patch]."""
+    hdr = read_tdas_header(path)
+    t_lo, t_hi = _row_range(hdr, time)
+    c_lo, c_hi = _ch_range(hdr, distance)
+    if t_hi - t_lo == 0 or c_hi - c_lo == 0:
+        return []
+    block = read_tdas_block(path, t_lo, t_hi, c_lo, c_hi)
+    return [_patch_from_block(hdr, block, t_lo, c_lo)]
+
+
+def scan_tdas(path):
+    """Metadata record for the directory index (no payload IO)."""
+    hdr = read_tdas_header(path)
+    t0 = np.datetime64(hdr["t0_ns"], "ns")
+    dt = np.timedelta64(hdr["dt_ns"], "ns")
+    return [
+        {
+            "path": str(path),
+            "format": FORMAT_NAME,
+            "dims": "time,distance",
+            "time_min": t0,
+            "time_max": t0 + (hdr["n_time"] - 1) * dt,
+            "time_step": dt,
+            "distance_min": float(hdr["d0"]),
+            "distance_max": float(
+                hdr["d0"] + (hdr["n_ch"] - 1) * hdr["dx"]
+            ),
+            "ntime": int(hdr["n_time"]),
+            "ndistance": int(hdr["n_ch"]),
+        }
+    ]
+
+
+def assemble_window(segments, c_lo, c_hi, total_rows, n_threads=None):
+    """Fill one contiguous (total_rows, c_hi-c_lo) float32 window from
+    per-file row segments ``(path, row_lo, row_hi, out_row0)`` — the
+    native-parallel host half of the overlap-save pipeline."""
+    out = np.empty((total_rows, c_hi - c_lo), np.float32)
+    lib = load_streamio()
+    if lib is None:
+        for path, r_lo, r_hi, o0 in segments:
+            hdr = read_tdas_header(path)
+            out[o0 : o0 + (r_hi - r_lo)] = _read_block_numpy(
+                path, hdr, r_lo, r_hi, c_lo, c_hi
+            )
+        return out
+    n = len(segments)
+    paths = (ctypes.c_char_p * n)(
+        *[os.fsencode(s[0]) for s in segments]
+    )
+    row_lo = (ctypes.c_uint64 * n)(*[int(s[1]) for s in segments])
+    row_hi = (ctypes.c_uint64 * n)(*[int(s[2]) for s in segments])
+    out_r0 = (ctypes.c_uint64 * n)(*[int(s[3]) for s in segments])
+    rc = lib.tdas_assemble_window(
+        paths,
+        row_lo,
+        row_hi,
+        out_r0,
+        n,
+        int(c_lo),
+        int(c_hi),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        int(n_threads or _default_threads()),
+    )
+    if rc != 0:
+        raise OSError(rc, "tdas_assemble_window failed")
+    return out

@@ -1,0 +1,105 @@
+"""Native (C++) ingest runtime, bound via ctypes.
+
+``load_streamio()`` compiles ``streamio.cpp`` on first use (g++ -O3,
+cached next to the source, keyed on source mtime) and returns a ctypes
+handle, or ``None`` when no toolchain is available / compilation fails /
+``TPUDAS_NO_NATIVE=1``. Callers in :mod:`tpudas.io.tdas` fall back to a
+pure-numpy implementation of the same format, so the framework is fully
+functional without a compiler — the native path is the performance
+runtime, not a hard dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_SRC = os.path.join(os.path.dirname(__file__), "streamio.cpp")
+_LIB = os.path.join(os.path.dirname(__file__), "libstreamio.so")
+
+_lock = threading.Lock()
+_cached: tuple[bool, ctypes.CDLL | None] | None = None
+
+
+def _compile() -> bool:
+    try:
+        src_mtime = os.path.getmtime(_SRC)
+    except OSError:
+        return False
+    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= src_mtime:
+        return True
+    cmd = [
+        "g++",
+        "-O3",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        "-pthread",
+        _SRC,
+        "-o",
+        _LIB + ".tmp",
+    ]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=120
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    if proc.returncode != 0:
+        return False
+    try:
+        os.replace(_LIB + ".tmp", _LIB)
+    except OSError:
+        return False
+    return True
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    u64, u32, f32, f64 = (
+        ctypes.c_uint64,
+        ctypes.c_uint32,
+        ctypes.c_float,
+        ctypes.c_double,
+    )
+    p = ctypes.POINTER
+    lib.tdas_write.restype = ctypes.c_int
+    lib.tdas_write.argtypes = [
+        ctypes.c_char_p, u64, u64, u32, u32, u32, f32, f64, f64,
+        ctypes.c_void_p,
+    ]
+    lib.tdas_read_header.restype = ctypes.c_int
+    lib.tdas_read_header.argtypes = [
+        ctypes.c_char_p, p(u64), p(u64), p(u32), p(u32), p(u32), p(f32),
+        p(f64), p(f64),
+    ]
+    lib.tdas_read_block.restype = ctypes.c_int
+    lib.tdas_read_block.argtypes = [
+        ctypes.c_char_p, u64, u64, u32, u32, p(f32), ctypes.c_int,
+    ]
+    lib.tdas_assemble_window.restype = ctypes.c_int
+    lib.tdas_assemble_window.argtypes = [
+        p(ctypes.c_char_p), p(u64), p(u64), p(u64), ctypes.c_int, u32, u32,
+        p(f32), ctypes.c_int,
+    ]
+    return lib
+
+
+def load_streamio() -> ctypes.CDLL | None:
+    """The compiled native library, or None (fallback mode)."""
+    global _cached
+    with _lock:
+        if _cached is not None:
+            return _cached[1]
+        if os.environ.get("TPUDAS_NO_NATIVE") == "1":
+            _cached = (False, None)
+            return None
+        lib = None
+        if _compile():
+            try:
+                lib = _bind(ctypes.CDLL(_LIB))
+            except OSError:
+                lib = None
+        _cached = (lib is not None, lib)
+        return lib

@@ -92,13 +92,16 @@ def make_synthetic_spool(
     fs=200.0,
     n_ch=16,
     start=DEFAULT_T0,
+    format="dasdae",
     **kwargs,
 ):
-    """Write ``n_files`` contiguous dasdae files into ``directory``."""
+    """Write ``n_files`` contiguous files into ``directory`` in the
+    given IO format ("dasdae" HDF5 or the native "tdas" stream)."""
     os.makedirs(directory, exist_ok=True)
     t0 = to_datetime64(start).astype("datetime64[ns]")
     step = np.timedelta64(int(round(1e9 / fs)), "ns")
     n = int(round(file_duration * fs))
+    suffix = ".tdas" if format == "tdas" else ".h5"
     paths = []
     for i in range(n_files):
         file_t0 = t0 + i * n * step
@@ -111,7 +114,7 @@ def make_synthetic_spool(
             phase_origin=t0,
             **kwargs,
         )
-        path = os.path.join(directory, f"raw_{i:04d}.h5")
-        write_patch(patch, path, format="dasdae")
+        path = os.path.join(directory, f"raw_{i:04d}{suffix}")
+        write_patch(patch, path, format=format)
         paths.append(path)
     return paths
