@@ -18,6 +18,6 @@ def test_entry_jits_and_runs():
 
 @pytest.mark.parametrize("n", [4, 8])
 def test_dryrun_multichip(n):
-    if len(jax.devices()) < n:
-        pytest.skip("needs virtual devices")
+    # no device-count gate: the dryrun spawns its own clean-env child
+    # with n virtual CPU devices, independent of this process's backend
     ge.dryrun_multichip(n)
