@@ -73,7 +73,7 @@ import numpy as np
 # fp32 MXU peak per chip, by generation (conservative public figures;
 # the MXU natively multiplies bf16 at 2x this — fp32 inputs take the
 # passes path).  Used only for the analytic MFU estimate.
-_PEAK_FP32 = {"v4": 137.5e12 / 2, "v5e": 197e12 / 2, "v5p": 459e12 / 2}
+_PEAK_FP32 = {"v4": 275e12 / 2, "v5e": 197e12 / 2, "v5p": 459e12 / 2}
 
 
 def _tail(raw, n=1500):
@@ -158,7 +158,9 @@ def _parent() -> None:
         if remaining < 60:
             break
         timeout = min(child_timeout, remaining)
-        env["BENCH_REMAINING"] = str(int(remaining))
+        # the child's compare gates must see the watchdog window, not
+        # the (possibly larger) total budget, or compare overruns it
+        env["BENCH_REMAINING"] = str(int(timeout))
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)],

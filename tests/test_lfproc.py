@@ -243,6 +243,34 @@ class TestEndToEnd:
         merged = spool(str(tmp_path / "out")).update().chunk(time=None)
         assert len(merged) >= 1  # produced output on both sides of the gap
 
+    def test_cascade_single_sample_tail_window(self, spool_dir, tmp_path):
+        # n_grid=142 with patch=60/buff=10 schedules a final window
+        # emitting exactly ONE grid point; the forced cascade engine
+        # must derive the ratio from the run-level grid step instead of
+        # raising "grid not sample-aligned" mid-run (ADVICE r1, medium)
+        from tpudas.proc.lfproc import schedule_windows
+
+        wins = schedule_windows(142, 60, 10)
+        assert wins[-1][3] - wins[-1][2] == 1  # precondition holds
+        lfp = LFProc(spool(spool_dir).sort("time").update())
+        lfp.update_processing_parameter(
+            output_sample_interval=DT_OUT,
+            process_patch_size=60,
+            edge_buff_size=10,
+            engine="cascade",
+        )
+        out = tmp_path / "tail1"
+        lfp.set_output_folder(str(out), delete_existing=True)
+        lfp.process_time_range(
+            np.datetime64("2023-03-22T00:00:00"),
+            np.datetime64("2023-03-22T00:02:22"),
+        )
+        merged = spool(str(out)).update().chunk(time=None)
+        assert len(merged) == 1  # contiguous incl. the 1-sample tail
+        times = merged[0].coords["time"]
+        # emitted coverage = [first emit_lo, last emit_hi) of the schedule
+        assert times.size == wins[-1][3] - wins[0][2]
+
     def test_gap_raise_mode(self, tmp_path):
         d = tmp_path / "gappy2"
         make_synthetic_spool(d, n_files=1, file_duration=30.0, fs=FS, n_ch=4)

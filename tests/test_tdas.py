@@ -41,6 +41,33 @@ class TestRoundtrip:
         write_patch(patch, p32, format="tdas")
         assert os.path.getsize(path) < 0.6 * os.path.getsize(p32)
 
+    def test_unknown_dtype_code_rejected(self, patch, tmp_path):
+        # a corrupt/future dtype code must fail identically in the
+        # numpy and native readers, not decode as float32 garbage
+        path = str(tmp_path / "bad.tdas")
+        write_patch(patch, path, format="tdas")
+        with open(path, "r+b") as fh:
+            fh.seek(32)  # dtype_code field (<4sIQQII|I|fddQ)
+            fh.write((7).to_bytes(4, "little"))
+        with pytest.raises(ValueError, match="dtype code"):
+            tdas.read_tdas_header(path)
+        from tpudas.native import load_streamio
+
+        lib = load_streamio()
+        if lib is not None:
+            import ctypes
+            import errno
+
+            u64, u32, f32, f64 = (
+                ctypes.c_uint64, ctypes.c_uint32, ctypes.c_float,
+                ctypes.c_double,
+            )
+            args = [u64(), u64(), u32(), u32(), u32(), f32(), f64(), f64()]
+            rc = lib.tdas_read_header(
+                os.fsencode(path), *(ctypes.byref(a) for a in args)
+            )
+            assert rc == errno.EINVAL
+
     def test_nonuniform_time_rejected(self, patch, tmp_path):
         coords = dict(patch.coords)
         t = coords["time"].copy()

@@ -52,6 +52,11 @@ def _unpack_header(raw: bytes) -> dict:
         raise ValueError("not a tdas file (bad magic)")
     if version != 1:
         raise ValueError(f"unsupported tdas version {version}")
+    if dtype_code not in _DTYPES:
+        # keep failure identical across the numpy and native readers: a
+        # corrupt/future file raises here (and EINVAL in C++) rather
+        # than decoding the payload as float32 garbage
+        raise ValueError(f"unsupported tdas dtype code {dtype_code}")
     return dict(
         t0_ns=t0_ns,
         dt_ns=dt_ns,

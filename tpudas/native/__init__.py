@@ -30,6 +30,11 @@ def _compile() -> bool:
         return False
     if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= src_mtime:
         return True
+    # compile to a process-unique temp in the same directory: concurrent
+    # processes (pytest-xdist, parallel streaming jobs) would otherwise
+    # interleave g++ writes on one shared inode and os.replace could
+    # publish a corrupted .so that then gets cached for process lifetime
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
     cmd = [
         "g++",
         "-O3",
@@ -39,21 +44,29 @@ def _compile() -> bool:
         "-pthread",
         _SRC,
         "-o",
-        _LIB + ".tmp",
+        tmp,
     ]
     try:
-        proc = subprocess.run(
-            cmd, capture_output=True, text=True, timeout=120
-        )
-    except (OSError, subprocess.TimeoutExpired):
-        return False
-    if proc.returncode != 0:
-        return False
-    try:
-        os.replace(_LIB + ".tmp", _LIB)
-    except OSError:
-        return False
-    return True
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=120
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return False
+        if proc.returncode != 0:
+            return False
+        try:
+            os.replace(tmp, _LIB)
+        except OSError:
+            return False
+        return True
+    finally:
+        # g++ may leave a partial object on any failure path above
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
 
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:

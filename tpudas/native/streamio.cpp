@@ -71,6 +71,9 @@ int read_header_fd(int fd, TdasHeader* h) {
   if (got != static_cast<ssize_t>(kHeaderSize)) return EIO;
   if (h->magic != kMagic) return EINVAL;
   if (h->version != kVersion) return ENOTSUP;
+  // known dtype codes only (0=f32, 1=i16): a corrupt/future file must
+  // fail consistently with the python reader, not decode as f32 noise
+  if (h->dtype != 0 && h->dtype != 1) return EINVAL;
   return 0;
 }
 

@@ -35,7 +35,11 @@ import numpy as np
 from tpudas.ops.fftlen import next_tpu_fft_len
 
 from tpudas.core.mapping import FrozenDict
-from tpudas.core.timeutils import build_time_grid, to_datetime64
+from tpudas.core.timeutils import (
+    build_time_grid,
+    quantize_step,
+    to_datetime64,
+)
 from tpudas.io.spool import spool as make_spool
 from tpudas.ops.resample import interp_indices_weights
 from tpudas.proc.naming import get_filename
@@ -267,19 +271,29 @@ class LFProc:
             grid_points=len(time_grid),
         )
 
-    def _cascade_alignment(self, taxis, target_times, d_sec):
+    def _cascade_alignment(self, taxis, target_times, d_sec, dt):
         """If the (ms-quantized) target grid lands exactly on input
         samples and the decimation ratio is a small-prime integer,
         return (ratio, phase) for the cascade engine; else None.
 
         The ratio is derived from the actual target-grid spacing (the
         quantized step from build_time_grid), NOT the configured float
-        interval — the two differ when dt is not a whole ms.
+        interval — the two differ when dt is not a whole ms.  A final
+        tail window can emit a single grid point (schedule_windows
+        yields emit size 1 when ``n_grid - data_end == 2``); with no
+        second sample to difference, the step falls back to the
+        run-level quantized grid step, which is what the slice was cut
+        from — the cascade stays usable instead of raising mid-run.
         """
-        if target_times.size < 2:
+        if target_times.size == 0:
             return None
         t_ns = target_times.astype("datetime64[ns]").astype(np.int64)
-        step_ns = t_ns[1] - t_ns[0]
+        if target_times.size >= 2:
+            step_ns = t_ns[1] - t_ns[0]
+        else:
+            step_ns = int(
+                quantize_step(dt).astype("timedelta64[ns]").astype(np.int64)
+            )
         if step_ns <= 0 or np.any(np.diff(t_ns) != step_ns):
             return None
         dsec_ns = float(d_sec) * 1e9
@@ -317,7 +331,7 @@ class LFProc:
             )
         align = None
         if engine in ("auto", "cascade"):
-            align = self._cascade_alignment(taxis, target_times, d_sec)
+            align = self._cascade_alignment(taxis, target_times, d_sec, dt)
             if align is None and engine == "cascade":
                 raise ValueError(
                     "engine='cascade' requires the output grid to land on "
