@@ -1,0 +1,233 @@
+"""Execute the four REFERENCE notebooks verbatim against the tpudas shims.
+
+This is the SURVEY.md §0 acceptance gate: the `.ipynb` files are loaded
+from ``/root/reference`` and every code cell is executed UNMODIFIED,
+except each notebook's cell 1, where only the three user-config path
+assignments (``data_path``, ``output_data_folder``,
+``output_figure_folder`` — `low_pass_dascore.ipynb:73-75`) are pointed
+at pytest tmp dirs; the rest of that cell (spool construction,
+get_contents) still runs as written.  Every test reports how many cells
+ran verbatim.
+
+The synthetic spool is shaped so the *hard-coded* notebook values work
+unchanged: timestamps on 2023-03-22 (cells reference
+'2023-03-22T03:00:00'..'07:00:00' and '06:00:00' literally), 1500
+channels (cells index ``coords['distance'][1400]`` and channel 1330),
+600-second files, at a 1 Hz sample rate (no notebook asserts the rate;
+1 Hz keeps 4 hours of 1500-channel data at ~86 MB).
+
+Reference quirks preserved on purpose:
+
+- ``low_pass_dascore.ipynb`` cells 8 and 9 call ``waterfall_plot`` with
+  10 positional args, but ``lf_das.py:110-122`` requires 12 — those
+  cells raise TypeError against the reference itself (the notebook
+  predates two added parameters).  The harness executes them verbatim,
+  asserts the reference-faithful TypeError, then proves the QC path
+  works by making the correct 12-arg call.
+- ``rolling_mean_dascore.ipynb`` cell 3 writes results into
+  ``output_figure_folder`` while cell 4 reads ``output_data_folder``
+  (`rolling_mean_dascore.ipynb:153-156` vs `:174`, the latent notebook
+  bug noted in SURVEY.md §2.1 C16).  Pointing both config vars at the
+  same tmp dir — a pure path choice — lets the whole notebook run
+  verbatim.
+- The ``*_edge`` notebooks sleep ``time_step_for_processing`` (>=125 s)
+  between polling rounds; the harness patches ``time.sleep`` to a
+  feeder that appends the next interrogator files instead, exercising
+  the real multi-round resume path at test speed.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tpudas.testing import make_synthetic_spool
+
+REF = "/root/reference"
+PATH_VARS = ("data_path", "output_data_folder", "output_figure_folder")
+
+# spool geometry matching the notebooks' hard-coded values (see module doc)
+N_CH = 1500
+FS = 1.0
+FILE_SEC = 600.0
+SIG = dict(fs=FS, n_ch=N_CH, lf_freq=0.01, hf_freq=0.2, noise=0.01)
+
+
+def load_code_cells(name):
+    with open(os.path.join(REF, name)) as fh:
+        nb = json.load(fh)
+    return [
+        "".join(c["source"])
+        for c in nb["cells"]
+        if c["cell_type"] == "code"
+    ]
+
+
+def sub_paths(src, mapping):
+    """Replace ONLY the three path-assignment lines of the config cell."""
+    lines, n = [], 0
+    for line in src.splitlines():
+        key = line.split("=")[0].strip() if "=" in line else None
+        if key in PATH_VARS:
+            lines.append(f"{key} = {mapping[key]!r}")
+            n += 1
+        else:
+            lines.append(line)
+    assert n == len(PATH_VARS), f"config cell drifted: {n} path lines"
+    return "\n".join(lines)
+
+
+def run_notebook(name, paths, expect_typeerror=()):
+    """Execute all code cells; cell 1 gets path substitution only."""
+    cells = load_code_cells(name)
+    ns = {"__name__": "__main__"}
+    verbatim = 0
+    for i, src in enumerate(cells):
+        if i == 1:
+            src = sub_paths(src, paths)
+        else:
+            verbatim += 1
+        code = compile(src, f"{name}[cell {i}]", "exec")
+        if i in expect_typeerror:
+            with pytest.raises(TypeError):
+                exec(code, ns)
+        else:
+            exec(code, ns)
+    print(
+        f"{name}: {verbatim}/{len(cells)} cells verbatim "
+        f"(cell 1: 3 path lines substituted)"
+    )
+    return ns
+
+
+def nb_paths(data_dir, out_tmp, shared_fig=False):
+    """Config paths: spool input at ``data_dir``, outputs under the
+    test's own ``out_tmp`` (never shared between tests)."""
+    out = out_tmp / "results"
+    fig = out if shared_fig else out_tmp / "figures"
+    out.mkdir(exist_ok=True)
+    fig.mkdir(exist_ok=True)
+    return {
+        "data_path": str(data_dir),
+        "output_data_folder": str(out),
+        "output_figure_folder": str(fig),
+    }
+
+
+@pytest.fixture(scope="module")
+def batch_spool(tmp_path_factory):
+    """4 h x 1500 ch covering the notebooks' literal 03:00-07:00 range."""
+    d = tmp_path_factory.mktemp("nbdata") / "data"
+    make_synthetic_spool(
+        d, n_files=24, file_duration=FILE_SEC,
+        start="2023-03-22T03:00:00", **SIG,
+    )
+    return d
+
+
+class TestLowPassBatch:
+    def test_verbatim(self, batch_spool, tmp_path):
+        paths = nb_paths(batch_spool, tmp_path)
+        # cells 8/9: reference-faithful TypeError (see module doc)
+        ns = run_notebook(
+            "low_pass_dascore.ipynb", paths, expect_typeerror={8, 9}
+        )
+        # the engine produced one contiguous merged result
+        assert len(ns["sp_result"]) == 1
+        n_samples = ns["sp_result"][0].data.shape[0]
+        assert n_samples * ns["d_t"] > 13990  # covers cell 8's max_sec
+        # figures from cells 6/7 were written
+        figs = os.listdir(paths["output_figure_folder"])
+        assert sum(f.endswith(".jpeg") for f in figs) >= 2
+        # prove the QC waterfall works when called per lf_das.py:110-122
+        ns["waterfall_plot"](
+            ns["demeaned_scaled_data"].T, 0, 13990, 0, 955,
+            ns["ch_start"], ns["channel_spacing"], 1185, 1 / ns["d_t"],
+            ns["fig_title"], paths["output_figure_folder"], "qc_12arg",
+        )
+        assert os.path.exists(
+            os.path.join(paths["output_figure_folder"], "qc_12arg.jpeg")
+        )
+
+
+class TestRollingBatch:
+    def test_verbatim(self, batch_spool, tmp_path):
+        # shared fig/data dir neutralizes the notebook's write-into-
+        # figure-folder bug without touching any non-path cell
+        paths = nb_paths(batch_spool, tmp_path, shared_fig=True)
+        ns = run_notebook("rolling_mean_dascore.ipynb", paths)
+        # cell 4's own assert passed; check the merged result is real
+        assert ns["time_no_nans"].shape[0] > 0
+        assert (
+            ns["rolling_merged_patch_no_nans"].data.shape[0]
+            == ns["time_no_nans"].shape[0]
+        )
+        files = os.listdir(paths["output_data_folder"])
+        assert sum(f.startswith("LFDAS_") for f in files) == 24
+
+
+def _edge_feeder(monkeypatch, data_dir, batches):
+    """Patch time.sleep so each polling-round sleep appends the next
+    batch of interrogator files instead of wall-waiting."""
+    import time as time_mod
+
+    calls = []
+
+    def fake_sleep(seconds):
+        calls.append(seconds)
+        if batches:
+            start, n = batches.pop(0)
+            make_synthetic_spool(
+                data_dir, n_files=n, file_duration=FILE_SEC,
+                start=start, prefix=f"feed{len(calls)}", **SIG,
+            )
+
+    monkeypatch.setattr(time_mod, "sleep", fake_sleep)
+    return calls
+
+
+class TestLowPassEdge:
+    def test_verbatim(self, tmp_path, monkeypatch):
+        data = tmp_path / "data"
+        # initial files 05:50-06:30; start_processing_time is the
+        # notebook's literal 2023-03-22T06:00:00
+        make_synthetic_spool(
+            data, n_files=4, file_duration=FILE_SEC,
+            start="2023-03-22T05:50:00", **SIG,
+        )
+        sleeps = _edge_feeder(
+            monkeypatch, data, [("2023-03-22T06:30:00", 2)]
+        )
+        paths = nb_paths(data, tmp_path)
+        ns = run_notebook("low_pass_dascore_edge.ipynb", paths)
+        assert ns["i"] == 2  # two processing rounds ran
+        assert len(sleeps) == 2  # slept after each round, then broke
+        from tpudas import spool
+
+        merged = spool(paths["output_data_folder"]).update().chunk(
+            time=None
+        )
+        assert len(merged) == 1  # resume-with-overlap left no seam
+        times = merged[0].coords["time"]
+        assert times[0] >= np.datetime64("2023-03-22T06:00:00")
+        assert times[-1] >= np.datetime64("2023-03-22T06:45:00")
+
+
+class TestRollingEdge:
+    def test_verbatim(self, tmp_path, monkeypatch):
+        data = tmp_path / "data"
+        make_synthetic_spool(
+            data, n_files=3, file_duration=FILE_SEC,
+            start="2023-03-22T06:00:00", **SIG,
+        )
+        sleeps = _edge_feeder(
+            monkeypatch, data, [("2023-03-22T06:30:00", 2)]
+        )
+        paths = nb_paths(data, tmp_path)
+        ns = run_notebook("rolling_mean_dascore_edge.ipynb", paths)
+        assert ns["i"] == 2
+        assert len(sleeps) == 2
+        files = os.listdir(paths["output_data_folder"])
+        # 3 initial + 2 fed patches, one output file each
+        assert sum(f.startswith("LFDAS_") for f in files) == 5

@@ -93,10 +93,16 @@ def make_synthetic_spool(
     n_ch=16,
     start=DEFAULT_T0,
     format="dasdae",
+    prefix="raw",
     **kwargs,
 ):
     """Write ``n_files`` contiguous files into ``directory`` in the
-    given IO format ("dasdae" HDF5 or the native "tdas" stream)."""
+    given IO format ("dasdae" HDF5 or the native "tdas" stream).
+
+    ``prefix`` names the files ``<prefix>_<i>.<ext>`` — pass a distinct
+    prefix when appending a later batch into an existing directory
+    (streaming tests), or the new files would overwrite the old.
+    """
     os.makedirs(directory, exist_ok=True)
     t0 = to_datetime64(start).astype("datetime64[ns]")
     step = np.timedelta64(int(round(1e9 / fs)), "ns")
@@ -114,7 +120,7 @@ def make_synthetic_spool(
             phase_origin=t0,
             **kwargs,
         )
-        path = os.path.join(directory, f"raw_{i:04d}{suffix}")
+        path = os.path.join(directory, f"{prefix}_{i:04d}{suffix}")
         write_patch(patch, path, format=format)
         paths.append(path)
     return paths
