@@ -54,8 +54,19 @@ Prints ONE JSON line:
                    measured ch-samp/s for cascade-xla / cascade-pallas /
                    fft so the 'auto' default is chosen from data
 
+BENCH_MODE=e2e measures the WHOLE product path instead of the resident
+kernel: a native tdas spool is synthesized on local disk and
+``LFProc.process_time_range`` runs over it — index planning, C++
+threaded window assembly on the prefetch thread, H2D, the fused device
+kernel, and HDF5 output writes all inside the timed region.  ``value``
+is then input channel-samples per wall-second of the full pipeline and
+``realtime_factor`` is the SURVEY §6 north-star number.  On this dev
+box the ~30 MB/s tunnel dominates e2e; the mode exists for hardware
+with local storage semantics.
+
 Env knobs: BENCH_T, BENCH_C, BENCH_ITERS, BENCH_ENGINE,
 BENCH_PALLAS=0/1, BENCH_INCLUDE_H2D=0/1, BENCH_COMPARE=0/1,
+BENCH_MODE=kernel/e2e, BENCH_E2E_SEC, BENCH_E2E_FS,
 BENCH_BUDGET (total parent wall budget, s), BENCH_PROBE_TIMEOUT,
 BENCH_CHILD_TIMEOUT.
 """
@@ -274,8 +285,88 @@ def _measure(kernel, T, C, iters, include_h2d):
     return elapsed
 
 
+def _e2e_child(backend: str) -> None:
+    """BENCH_MODE=e2e: the full product path on a local tdas spool."""
+    import tempfile
+
+    import numpy as _np
+
+    from tpudas import spool as make_spool
+    from tpudas.proc.lfproc import LFProc
+    from tpudas.testing import make_synthetic_spool
+
+    C = int(os.environ.get("BENCH_C", 1024))
+    sec = int(os.environ.get("BENCH_E2E_SEC", 120))
+    fs = float(os.environ.get("BENCH_E2E_FS", 1000.0))
+    engine = os.environ.get("BENCH_ENGINE", "auto")
+    file_sec = 30.0
+    # the timed range must equal the synthesized data span exactly, or
+    # the reported rate would credit samples never read
+    n_files = max(1, round(sec / file_sec))
+    sec = int(n_files * file_sec)
+    start = "2023-03-22T00:00:00"
+
+    with tempfile.TemporaryDirectory() as td:
+        src = os.path.join(td, "src")
+        out = os.path.join(td, "out")
+        print(
+            f"[bench] e2e: synthesizing {sec}s x {C}ch @ {fs:.0f}Hz tdas "
+            "spool",
+            file=sys.stderr,
+            flush=True,
+        )
+        make_synthetic_spool(
+            src, n_files=n_files, file_duration=file_sec,
+            fs=fs, n_ch=C, noise=0.01, lf_freq=0.05, hf_freq=40.0,
+            format="tdas",
+        )
+        lfp = LFProc(make_spool(src).sort("time").update())
+        lfp.update_processing_parameter(
+            output_sample_interval=1.0,
+            process_patch_size=60,
+            edge_buff_size=10,
+            engine=engine,
+        )
+        lfp.set_output_folder(out, delete_existing=True)
+        t0 = _np.datetime64(start)
+        t1 = t0 + _np.timedelta64(sec, "s")
+        w0 = time.perf_counter()
+        lfp.process_time_range(t0, t1)
+        elapsed = time.perf_counter() - w0
+        n_out = len(os.listdir(out))
+
+    value = sec * fs * C / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "channel_samples_per_sec",
+                "value": round(value, 1),
+                "unit": "channel_samples/sec",
+                "vs_baseline": round(value / 1e8, 4),
+                "realtime_factor": round(sec / elapsed, 2),
+                "backend": backend,
+                "engine": engine,
+                "mode": "e2e",
+                "shape": [int(sec * fs), C],
+                "native_windows": lfp.native_windows,
+                "output_files": n_out,
+            }
+        )
+    )
+
+
 def _child() -> None:
     import jax
+
+    if os.environ.get("BENCH_MODE", "kernel") == "e2e":
+        backend = jax.default_backend()
+        print(
+            f"[bench] child backend={backend} mode=e2e",
+            file=sys.stderr,
+            flush=True,
+        )
+        _e2e_child(backend)
+        return
 
     T = int(os.environ.get("BENCH_T", 131072))  # ~131 s @ 1 kHz
     C = int(os.environ.get("BENCH_C", 2048))

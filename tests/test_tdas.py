@@ -191,6 +191,76 @@ class TestSpoolIntegration:
                 np.datetime64("2023-03-22T00:02:00"),
             )
             results[fmt] = spool(str(out)).update().chunk(time=None)[0]
+            # tdas spools must take the native window-assembly fast
+            # path for every window; dasdae spools never do
+            expect = {"tdas": lambda n: n > 0, "dasdae": lambda n: n == 0}
+            assert expect[fmt](lfp.native_windows), (fmt, lfp.native_windows)
         assert np.array_equal(
             results["tdas"].host_data(), results["dasdae"].host_data()
+        )
+
+
+class TestWindowPlan:
+    def test_plan_matches_merge(self, tmp_path):
+        make_synthetic_spool(
+            tmp_path, n_files=3, file_duration=10.0, fs=100.0, n_ch=8,
+            noise=0.05, format="tdas",
+        )
+        sp = spool(str(tmp_path)).sort("time").update()
+        t_lo = np.datetime64("2023-03-22T00:00:04")
+        t_hi = np.datetime64("2023-03-22T00:00:27.5")
+        plan = sp.native_window_plan(t_lo, t_hi)
+        assert plan is not None
+        assert len(plan["segments"]) == 3
+        fast = tdas.assemble_window_patch(plan)
+        merged = spool(sp.select(time=(t_lo, t_hi))).chunk(time=None)[0]
+        assert np.array_equal(fast.host_data(), merged.host_data())
+        assert np.array_equal(
+            fast.coords["time"], merged.coords["time"]
+        )
+        assert np.allclose(
+            fast.coords["distance"], merged.coords["distance"]
+        )
+
+    def test_plan_honors_distance_selection(self, tmp_path):
+        make_synthetic_spool(
+            tmp_path, n_files=2, file_duration=10.0, fs=100.0, n_ch=8,
+            d_ch=5.0, format="tdas",
+        )
+        sp = spool(str(tmp_path)).update().select(distance=(10.0, 25.0))
+        plan = sp.native_window_plan(
+            np.datetime64("2023-03-22T00:00:00"),
+            np.datetime64("2023-03-22T00:00:15"),
+        )
+        assert plan is not None
+        assert (plan["c_lo"], plan["c_hi"]) == (2, 6)
+
+    def test_plan_none_for_gap(self, tmp_path):
+        make_synthetic_spool(
+            tmp_path, n_files=1, file_duration=10.0, fs=100.0, n_ch=4,
+            format="tdas",
+        )
+        make_synthetic_spool(
+            tmp_path, n_files=1, file_duration=10.0, fs=100.0, n_ch=4,
+            format="tdas", start="2023-03-22T00:01:00", prefix="late",
+        )
+        sp = spool(str(tmp_path)).sort("time").update()
+        plan = sp.native_window_plan(
+            np.datetime64("2023-03-22T00:00:00"),
+            np.datetime64("2023-03-22T00:01:05"),
+        )
+        assert plan is None  # gap -> generic path decides on_gap policy
+
+    def test_plan_none_for_dasdae(self, tmp_path):
+        make_synthetic_spool(
+            tmp_path, n_files=2, file_duration=10.0, fs=100.0, n_ch=4,
+            format="dasdae",
+        )
+        sp = spool(str(tmp_path)).update()
+        assert (
+            sp.native_window_plan(
+                np.datetime64("2023-03-22T00:00:00"),
+                np.datetime64("2023-03-22T00:00:15"),
+            )
+            is None
         )

@@ -342,3 +342,15 @@ class DirectorySpool(BaseSpool):
 
     def get_contents(self) -> pd.DataFrame:
         return self._frame()
+
+    def native_window_plan(self, t_lo, t_hi):
+        """An :func:`tpudas.io.tdas.plan_window_from_records` plan for
+        the window [t_lo, t_hi] honoring this spool's distance
+        selection, or None when the native fast path does not apply
+        (non-tdas files, mixed geometry, coverage gap)."""
+        from tpudas.io.tdas import plan_window_from_records
+
+        df = self.select(time=(t_lo, t_hi))._frame()
+        return plan_window_from_records(
+            (row for _, row in df.iterrows()), t_lo, t_hi, self._distance
+        )

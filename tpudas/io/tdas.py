@@ -271,6 +271,95 @@ def scan_tdas(path):
     ]
 
 
+def plan_window_from_records(records, t_lo, t_hi, distance=None):
+    """Plan a contiguous window assembly straight from index records.
+
+    ``records``: iterable of directory-index rows (dicts) sorted by
+    ``time_min``, each carrying path/format/time_min/time_step/ntime/
+    distance_min/distance_max/ndistance — everything needed to compute
+    per-file row segments WITHOUT opening any file.  Returns a plan
+    dict for :func:`assemble_window` (segments, c_lo, c_hi, total_rows,
+    t0_ns, dt_ns, d0, dx) or None when the fast path does not apply
+    (non-tdas files, mixed geometry, or a coverage gap — the generic
+    merge path then handles gap policy).
+
+    Row selection matches :func:`_row_range` (inclusive bounds) so the
+    assembled window is byte-identical to per-file read + merge.
+    """
+    recs = list(records)
+    if not recs:
+        return None
+    first = recs[0]
+    if any(r.get("format") != FORMAT_NAME for r in recs):
+        return None
+    dt_ns = np.timedelta64(first["time_step"], "ns").astype(np.int64)
+    if dt_ns <= 0:
+        return None
+    nd = int(first["ndistance"])
+    d0 = float(first["distance_min"])
+    d_max = float(first["distance_max"])
+    dx = (d_max - d0) / (nd - 1) if nd > 1 else 0.0
+    for r in recs:
+        if (
+            np.timedelta64(r["time_step"], "ns").astype(np.int64) != dt_ns
+            or int(r["ndistance"]) != nd
+            or float(r["distance_min"]) != d0
+            or float(r["distance_max"]) != d_max
+        ):
+            return None
+    c_lo, c_hi = _ch_range(
+        {"n_ch": nd, "d0": d0, "dx": dx}, distance
+    )
+    if c_hi - c_lo == 0:
+        return None
+    segments, total, next_ns, t0_out = [], 0, None, None
+    for r in recs:
+        f0 = np.datetime64(r["time_min"], "ns").astype(np.int64)
+        # structural parity with the generic path: the same _row_range
+        # that read_tdas uses picks this file's rows
+        r_lo, r_hi = _row_range(
+            {"n_time": int(r["ntime"]), "t0_ns": f0, "dt_ns": dt_ns},
+            (t_lo, t_hi),
+        )
+        if r_hi <= r_lo:
+            continue
+        seg_t0 = f0 + r_lo * dt_ns
+        if next_ns is None:
+            t0_out = seg_t0
+        elif seg_t0 != next_ns:
+            return None  # coverage gap or overlap: generic path decides
+        segments.append((r["path"], r_lo, r_hi, total))
+        total += r_hi - r_lo
+        next_ns = f0 + r_hi * dt_ns
+    if total == 0:
+        return None
+    return {
+        "segments": segments,
+        "c_lo": c_lo,
+        "c_hi": c_hi,
+        "total_rows": total,
+        "t0_ns": int(t0_out),
+        "dt_ns": int(dt_ns),
+        "d0": d0,
+        "dx": dx,
+    }
+
+
+def assemble_window_patch(plan, n_threads=None) -> Patch:
+    """Execute a :func:`plan_window_from_records` plan: one native
+    threaded multi-file read into a single pinned float32 buffer,
+    wrapped as a Patch (the overlap-save hot-loop ingest,
+    SURVEY.md §3.1 hot loops #2/#3)."""
+    data = assemble_window(
+        plan["segments"], plan["c_lo"], plan["c_hi"], plan["total_rows"],
+        n_threads=n_threads,
+    )
+    # plan carries t0_ns/dt_ns/d0/dx — exactly the header keys
+    # _patch_from_block reads, so coordinate construction stays single-
+    # sourced with the per-file reader
+    return _patch_from_block(plan, data, 0, plan["c_lo"])
+
+
 def assemble_window(segments, c_lo, c_hi, total_rows, n_threads=None):
     """Fill one contiguous (total_rows, c_hi-c_lo) float32 window from
     per-file row segments ``(path, row_lo, row_hi, out_row0)`` — the
