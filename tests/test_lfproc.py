@@ -271,6 +271,90 @@ class TestEndToEnd:
         # emitted coverage = [first emit_lo, last emit_hi) of the schedule
         assert times.size == wins[-1][3] - wins[0][2]
 
+    def test_gap_split_mode(self, tmp_path):
+        # 60 s of data, a 60 s gap (> data_gap_tolorance), 60 s more:
+        # on_gap="split" must emit one contiguous run per side of the
+        # gap and never raise (lf_das.py:202's promised semantics)
+        d = tmp_path / "gappy3"
+        make_synthetic_spool(
+            d, n_files=2, file_duration=30.0, fs=FS, n_ch=4, noise=0.0
+        )
+        make_synthetic_spool(
+            d, n_files=2, file_duration=30.0, fs=FS, n_ch=4, noise=0.0,
+            start="2023-03-22T00:02:00", prefix="late",
+        )
+        lfp = LFProc(spool(str(d)).sort("time").update())
+        lfp.update_processing_parameter(
+            output_sample_interval=DT_OUT,
+            process_patch_size=40,
+            edge_buff_size=5,
+            on_gap="split",
+            data_gap_tolorance=10.0,
+        )
+        out = tmp_path / "out3"
+        lfp.set_output_folder(str(out), delete_existing=True)
+        lfp.process_time_range(
+            np.datetime64("2023-03-22T00:00:00"),
+            np.datetime64("2023-03-22T00:03:00"),
+        )
+        merged = spool(str(out)).update().chunk(time=None)
+        assert len(merged) == 2  # one contiguous run per segment
+        runs = sorted(
+            (p.coords["time"][0], p.coords["time"][-1]) for p in merged
+        )
+        # each run is interior to its segment (edge buffer trimmed at
+        # the segment start, tail reaching the segment end)
+        assert runs[0][0] == np.datetime64("2023-03-22T00:00:05")
+        assert runs[0][1] <= np.datetime64("2023-03-22T00:01:00")
+        assert runs[1][0] == np.datetime64("2023-03-22T00:02:05")
+        assert runs[1][1] <= np.datetime64("2023-03-22T00:03:00")
+
+    def test_gap_split_single_segment_matches_contiguous(self, spool_dir,
+                                                         tmp_path):
+        # with no gaps, split mode must be byte-identical to the default
+        outs = {}
+        for mode in ("raise", "split"):
+            lfp = LFProc(spool(spool_dir).sort("time").update())
+            lfp.update_processing_parameter(
+                output_sample_interval=DT_OUT,
+                process_patch_size=60,
+                edge_buff_size=10,
+                on_gap=mode,
+            )
+            out = tmp_path / f"split_{mode}"
+            lfp.set_output_folder(str(out), delete_existing=True)
+            lfp.process_time_range(
+                np.datetime64("2023-03-22T00:00:00"),
+                np.datetime64("2023-03-22T00:02:00"),
+            )
+            outs[mode] = spool(str(out)).update().chunk(time=None)[0]
+        assert np.array_equal(
+            outs["raise"].host_data(), outs["split"].host_data()
+        )
+
+    def test_invalid_on_gap_rejected(self):
+        lfp = LFProc()
+        with pytest.raises(ValueError, match="on_gap"):
+            lfp.update_processing_parameter(on_gap="bogus")
+
+    def test_split_mode_invalid_patch_buff_raises(self, spool_dir,
+                                                  tmp_path):
+        # an invalid global config must fail loudly, not be swallowed
+        # per segment as "too short"
+        lfp = LFProc(spool(spool_dir).sort("time").update())
+        lfp.update_processing_parameter(
+            output_sample_interval=DT_OUT,
+            process_patch_size=20,
+            edge_buff_size=10,
+            on_gap="split",
+        )
+        lfp.set_output_folder(str(tmp_path / "bad"), delete_existing=True)
+        with pytest.raises(ValueError, match="process_patch_size"):
+            lfp.process_time_range(
+                np.datetime64("2023-03-22T00:00:00"),
+                np.datetime64("2023-03-22T00:02:00"),
+            )
+
     def test_gap_raise_mode(self, tmp_path):
         d = tmp_path / "gappy2"
         make_synthetic_spool(d, n_files=1, file_duration=30.0, fs=FS, n_ch=4)

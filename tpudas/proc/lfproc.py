@@ -162,7 +162,14 @@ class LFProc:
             "process_patch_size": 100,  # output samples per window
             "edge_buff_size": 10,  # output samples of trimmed halo
             "data_gap_tolorance": 10.0,
-            "on_gap": "raise",  # "raise" | "skip": split-at-gap policy
+            # "raise": reference behavior (merge failure halts the run,
+            # lf_das.py:16-20). "skip": drop windows touching a gap.
+            # "split": segment the time grid at index-detected gaps
+            # wider than data_gap_tolorance and run overlap-save per
+            # segment, emitting per-segment output (the behavior the
+            # reference's dead data_gap_tolorance parameter promises,
+            # lf_das.py:202, SURVEY.md §5).
+            "on_gap": "raise",
             "filter_order": 4,
             # "auto": multistage polyphase FIR cascade (tpudas.ops.fir,
             # Pallas on TPU) when the target grid is sample-aligned and
@@ -172,6 +179,7 @@ class LFProc:
         }
 
     _ENGINES = ("auto", "fft", "cascade")
+    _GAP_MODES = ("raise", "skip", "split")
 
     def update_processing_parameter(self, **kwargs):
         for key, value in kwargs.items():
@@ -180,6 +188,10 @@ class LFProc:
             elif key == "engine" and value not in self._ENGINES:
                 raise ValueError(
                     f"engine must be one of {self._ENGINES}, got {value!r}"
+                )
+            elif key == "on_gap" and value not in self._GAP_MODES:
+                raise ValueError(
+                    f"on_gap must be one of {self._GAP_MODES}, got {value!r}"
                 )
             else:
                 self._para[key] = value
@@ -242,19 +254,102 @@ class LFProc:
                 raise
             return None
 
+    def _split_grid_at_gaps(self, time_grid):
+        """[(g_lo, g_hi), ...] index ranges of ``time_grid`` covered by
+        contiguous data, split at gaps wider than data_gap_tolorance
+        seconds (detected from the spool index — no payload IO)."""
+        if len(time_grid) == 0:
+            return []
+        tol_ns = float(self._para["data_gap_tolorance"]) * 1e9
+        df = self._spool.get_contents()
+        if df is None or len(df) == 0:
+            return []
+        mins = df["time_min"].to_numpy().astype("datetime64[ns]")
+        maxs = df["time_max"].to_numpy().astype("datetime64[ns]")
+        order = np.argsort(mins, kind="stable")
+        mins, maxs = mins[order].astype(np.int64), maxs[order].astype(
+            np.int64
+        )
+        # merge file intervals into coverage runs; a separation wider
+        # than the tolerance starts a new run
+        runs = []
+        run_lo, run_hi = mins[0], maxs[0]
+        for lo, hi in zip(mins[1:], maxs[1:]):
+            if lo - run_hi > tol_ns:
+                runs.append((run_lo, run_hi))
+                run_lo, run_hi = lo, hi
+            else:
+                run_hi = max(run_hi, hi)
+        runs.append((run_lo, run_hi))
+        grid_ns = time_grid.astype("datetime64[ns]").astype(np.int64)
+        segments = []
+        for lo, hi in runs:
+            g_lo = int(np.searchsorted(grid_ns, lo, side="left"))
+            g_hi = int(np.searchsorted(grid_ns, hi, side="right"))
+            if g_hi - g_lo >= 2:
+                segments.append((g_lo, g_hi))
+        return segments
+
     def process_time_range(self, bgtime, edtime):
         """Chunked overlap-save low-pass + decimate over [bg, ed)."""
         if self._output_folder is None:
             raise Exception("Please setup output folder first")
         dt = self._para["output_sample_interval"]
-        patch_size = self._para["process_patch_size"]
-        buff_size = self._para["edge_buff_size"]
         on_gap = self._para["on_gap"]
-        order = self._para["filter_order"]
 
         bgtime = to_datetime64(bgtime)
         edtime = to_datetime64(edtime)
         time_grid = build_time_grid(bgtime, edtime, dt)
+        if on_gap == "split":
+            # a globally invalid patch/buff relation must fail loudly
+            # here — per-segment scheduling errors are otherwise
+            # swallowed as "segment too short"
+            patch_size = self._para["process_patch_size"]
+            buff_size = self._para["edge_buff_size"]
+            if patch_size <= 2 * buff_size:
+                raise ValueError(
+                    f"process_patch_size ({patch_size}) must exceed "
+                    f"2*edge_buff_size ({2 * buff_size})"
+                )
+            segments = self._split_grid_at_gaps(time_grid)
+        else:
+            segments = [(0, len(time_grid))]
+        total_windows = 0
+        for s_i, (g_lo, g_hi) in enumerate(segments):
+            if len(segments) > 1:
+                print(
+                    f"Processing segment {s_i + 1}/{len(segments)} "
+                    f"[{time_grid[g_lo]} .. {time_grid[g_hi - 1]}]"
+                )
+                log_event(
+                    "segment_start",
+                    index=s_i + 1,
+                    segments=len(segments),
+                    grid_points=g_hi - g_lo,
+                )
+            total_windows += self._process_segment(
+                time_grid[g_lo:g_hi], on_gap
+            )
+        log_event(
+            "process_time_range_done",
+            windows=total_windows,
+            grid_points=len(time_grid),
+            segments=len(segments),
+        )
+
+    def _process_segment(self, time_grid, on_gap) -> int:
+        """Overlap-save over one contiguous grid segment; returns the
+        number of scheduled windows."""
+        dt = self._para["output_sample_interval"]
+        patch_size = self._para["process_patch_size"]
+        buff_size = self._para["edge_buff_size"]
+        order = self._para["filter_order"]
+        if on_gap == "split" and len(time_grid) - 1 <= 2 * buff_size:
+            # a between-gaps segment too short for the halo: nothing
+            # recoverable there, but the run must go on (the global
+            # patch/buff config was validated in process_time_range)
+            log_event("segment_too_short", grid_points=len(time_grid))
+            return 0
         windows = schedule_windows(len(time_grid), patch_size, buff_size)
         corner = 1.0 / dt / 2.0 * 0.9  # 0.9x post-decimation Nyquist
 
@@ -289,11 +384,7 @@ class LFProc:
                     corner,
                     order,
                 )
-        log_event(
-            "process_time_range_done",
-            windows=len(windows),
-            grid_points=len(time_grid),
-        )
+        return len(windows)
 
     def _cascade_alignment(self, taxis, target_times, d_sec, dt):
         """If the (ms-quantized) target grid lands exactly on input
@@ -394,6 +485,14 @@ class LFProc:
                     )
                 else:
                     align = None  # auto: fall back to the FFT engine
+        # observability: which engine actually ran this window (config
+        # says "auto"/"cascade"; this event is the ground truth)
+        log_event(
+            "window_engine",
+            engine="cascade" if align is not None else "fft",
+            rows=int(host.shape[0]),
+            emitted=int(target_times.size),
+        )
         if align is not None:
             out = cascade_decimate(
                 host.astype(np.float32, copy=False),

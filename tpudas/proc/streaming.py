@@ -27,8 +27,33 @@ from tpudas.io.spool import spool as make_spool
 from tpudas.proc.lfproc import LFProc
 from tpudas.proc.naming import get_filename
 from tpudas.utils.logging import log_event
+from tpudas.utils.profiling import Counters
 
 __all__ = ["clamp_poll_interval", "run_lowpass_realtime", "run_rolling_realtime"]
+
+
+def _covered_workload(contents, t1, t2):
+    """(data_seconds, channel_samples) actually present in the index
+    within [t1, t2) — gaps and heterogeneous files are accounted per
+    file, so round metrics stay honest across outages and rewinds."""
+    lo = to_datetime64(t1).astype("datetime64[ns]")
+    hi = to_datetime64(t2).astype("datetime64[ns]")
+    data_ns = 0.0
+    samples = 0.0
+    for _, row in contents.iterrows():
+        f_lo = np.datetime64(row["time_min"], "ns")
+        f_hi = np.datetime64(row["time_max"], "ns")
+        span_ns = (f_hi - f_lo) / np.timedelta64(1, "ns")
+        ov = min(hi, f_hi) - max(lo, f_lo)
+        ov_ns = ov / np.timedelta64(1, "ns")
+        if ov_ns <= 0:
+            continue
+        data_ns += ov_ns
+        n_time = float(row.get("ntime") or 0)
+        if span_ns > 0 and n_time > 1:
+            fs = (n_time - 1) / (span_ns / 1e9)
+            samples += ov_ns / 1e9 * fs * float(row.get("ndistance") or 0)
+    return data_ns / 1e9, samples
 
 
 def clamp_poll_interval(requested, file_duration, edge_buffer):
@@ -53,8 +78,20 @@ def run_lowpass_realtime(
     max_rounds=None,
     sleep_fn=_time.sleep,
     on_round=None,
+    engine=None,
+    on_gap=None,
+    filter_order=None,
+    data_gap_tolorance=None,
+    counters=None,
 ):
     """Poll ``source`` and keep the low-pass output current.
+
+    ``engine`` / ``on_gap`` / ``filter_order`` / ``data_gap_tolorance``
+    are forwarded to :class:`LFProc` (None keeps its defaults), so the
+    streaming path can run the cascade engine and gap policies the batch
+    path has.  Pass a :class:`tpudas.utils.profiling.Counters` to
+    accumulate throughput; each processing round also emits a
+    ``realtime_round`` event with its own real-time factor.
 
     Returns the number of rounds that processed data. Terminates when a
     poll sees no new files (reference semantics) or after
@@ -64,6 +101,17 @@ def run_lowpass_realtime(
     buff_out = int(np.ceil(edge_buffer / d_t))
     interval = clamp_poll_interval(poll_interval, file_duration, edge_buffer)
     start_time = to_datetime64(start_time)
+    extra = {
+        k: v
+        for k, v in (
+            ("engine", engine),
+            ("on_gap", on_gap),
+            ("filter_order", filter_order),
+            ("data_gap_tolorance", data_gap_tolorance),
+        )
+        if v is not None
+    }
+    counters = counters if counters is not None else Counters()
 
     processed_once = False  # first PROCESSING round always starts at
     # start_time, however many empty polls precede it (a pre-existing
@@ -85,6 +133,7 @@ def run_lowpass_realtime(
                 output_sample_interval=d_t,
                 process_patch_size=int(process_patch_size),
                 edge_buff_size=buff_out,
+                **extra,
             )
             lfp.set_output_folder(output_folder, delete_existing=False)
             rounds += 1
@@ -109,9 +158,26 @@ def run_lowpass_realtime(
                     rewind_sec = (math.ceil(edge_buffer / d_t) - 1) * d_t
                     t1 = t_last - to_timedelta64(rewind_sec)
             # newest timestamp from the index — no file data is read
-            t2 = np.datetime64(sub.get_contents()["time_max"].max())
-            lfp.process_time_range(t1, t2)
-            log_event("realtime_round", round=rounds, upto=str(t2))
+            contents = sub.get_contents()
+            t2 = np.datetime64(contents["time_max"].max())
+            data_sec, ch_samples = _covered_workload(contents, t1, t2)
+            with counters.measure(int(ch_samples), data_sec):
+                lfp.process_time_range(t1, t2)
+            round_rt = (
+                data_sec / counters.last_wall
+                if counters.last_wall
+                else 0.0
+            )
+            log_event(
+                "realtime_round",
+                round=rounds,
+                upto=str(t2),
+                data_seconds=round(data_sec, 3),
+                wall_seconds=round(counters.last_wall, 4),
+                realtime_factor=round(round_rt, 2),
+                engine=lfp.parameters["engine"],
+                native_windows=lfp.native_windows,
+            )
             if on_round is not None:
                 on_round(rounds, lfp)
             processed_once = True

@@ -31,12 +31,14 @@ class Counters:
         self.channel_samples = 0
         self.data_seconds = 0.0
         self.wall_seconds = 0.0
+        self.last_wall = 0.0  # duration of the most recent measure()
 
     @contextmanager
     def measure(self, channel_samples: int, data_seconds: float):
         t0 = time.perf_counter()
         yield
-        self.wall_seconds += time.perf_counter() - t0
+        self.last_wall = time.perf_counter() - t0
+        self.wall_seconds += self.last_wall
         self.channel_samples += int(channel_samples)
         self.data_seconds += float(data_seconds)
 
