@@ -1,0 +1,74 @@
+"""Multi-host (DCN) init hooks: env parsing, arg precedence,
+idempotence, and the process-0 coordinator case — with
+``jax.distributed.initialize`` mocked (no cluster needed, SURVEY.md
+§2.4 DCN row)."""
+
+import jax
+import pytest
+
+import tpudas.parallel.distributed as dist
+
+
+@pytest.fixture(autouse=True)
+def reset_state(monkeypatch):
+    monkeypatch.setattr(dist, "_initialized", False)
+    for var in ("COORDINATOR_ADDRESS", "NUM_PROCESSES", "PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    calls = []
+    monkeypatch.setattr(
+        jax.distributed, "initialize", lambda **kw: calls.append(kw)
+    )
+    yield calls
+
+
+class TestInitializeMultihost:
+    def test_noop_without_config(self, reset_state):
+        assert dist.initialize_multihost() is False
+        assert reset_state == []
+
+    def test_env_parsing(self, reset_state, monkeypatch):
+        monkeypatch.setenv("COORDINATOR_ADDRESS", "10.0.0.1:8476")
+        monkeypatch.setenv("NUM_PROCESSES", "8")
+        monkeypatch.setenv("PROCESS_ID", "3")
+        assert dist.initialize_multihost() is True
+        assert reset_state == [
+            {
+                "coordinator_address": "10.0.0.1:8476",
+                "num_processes": 8,
+                "process_id": 3,
+            }
+        ]
+
+    def test_explicit_args_beat_env(self, reset_state, monkeypatch):
+        monkeypatch.setenv("COORDINATOR_ADDRESS", "env:1")
+        monkeypatch.setenv("NUM_PROCESSES", "2")
+        monkeypatch.setenv("PROCESS_ID", "1")
+        assert dist.initialize_multihost("arg:2", 4, 2) is True
+        (call,) = reset_state
+        assert call["coordinator_address"] == "arg:2"
+        assert call["num_processes"] == 4
+        assert call["process_id"] == 2
+
+    def test_process_zero_is_not_dropped(self, reset_state):
+        # `process_id or env` would lose the coordinator (id 0)
+        assert (
+            dist.initialize_multihost("10.0.0.1:8476", 2, 0) is True
+        )
+        assert reset_state[0]["process_id"] == 0
+
+    def test_idempotent(self, reset_state):
+        assert dist.initialize_multihost("10.0.0.1:8476", 2, 0) is True
+        assert dist.initialize_multihost("10.0.0.1:8476", 2, 0) is False
+        assert len(reset_state) == 1
+
+    def test_partial_config_is_noop(self, reset_state, monkeypatch):
+        monkeypatch.setenv("COORDINATOR_ADDRESS", "10.0.0.1:8476")
+        # NUM_PROCESSES / PROCESS_ID missing
+        assert dist.initialize_multihost() is False
+        assert reset_state == []
+
+
+class TestQueries:
+    def test_single_process(self):
+        assert dist.is_distributed() is False
+        assert len(dist.global_mesh_devices()) == len(jax.devices())

@@ -355,6 +355,51 @@ class TestEndToEnd:
                 np.datetime64("2023-03-22T00:02:00"),
             )
 
+    def test_10k_channel_window_config4_shapes(self, tmp_path):
+        """BASELINE config 4 shapes on CPU: one overlap-save window of a
+        10,000-channel 1 kHz stream through schedule_windows ->
+        _process_window, both engines — exercises the static-shape /
+        memory story at production channel count before hardware."""
+        from tpudas.core.patch import Patch
+        from tpudas.core.timeutils import build_time_grid
+
+        fs, n_ch, d_t = 1000.0, 10_000, 1.0
+        patch_size, buff = 16, 2
+        t0 = np.datetime64("2023-03-22T00:00:00")
+        grid = build_time_grid(
+            t0, t0 + np.timedelta64(patch_size + 1, "s"), d_t
+        )
+        wins = schedule_windows(len(grid), patch_size, buff)
+        assert len(wins) == 1
+        sel_lo, sel_hi, emit_lo, emit_hi = wins[0]
+        T = sel_hi * 1000 + 1  # rows covering [grid[0], grid[sel_hi]]
+        rng = np.random.default_rng(0)
+        data = rng.standard_normal((T, n_ch)).astype(np.float32)
+        times = t0.astype("datetime64[ns]") + np.arange(T) * np.timedelta64(
+            1_000_000, "ns"
+        )
+        window = Patch(
+            data=data,
+            coords={
+                "time": times,
+                "distance": np.arange(n_ch, dtype=np.float64),
+            },
+            dims=("time", "distance"),
+        )
+        corner = 1.0 / d_t / 2.0 * 0.9
+        for engine in ("cascade", "fft"):
+            lfp = LFProc()
+            lfp.update_processing_parameter(engine=engine)
+            out = tmp_path / f"big_{engine}"
+            lfp.set_output_folder(str(out), delete_existing=True)
+            lfp._process_window(
+                window, grid[emit_lo:emit_hi], d_t, corner, 4
+            )
+            (fname,) = os.listdir(out)
+            (result,) = spool(str(out)).update()
+            assert result.host_data().shape == (emit_hi - emit_lo, n_ch)
+            assert np.isfinite(result.host_data()).all()
+
     def test_gap_raise_mode(self, tmp_path):
         d = tmp_path / "gappy2"
         make_synthetic_spool(d, n_files=1, file_duration=30.0, fs=FS, n_ch=4)

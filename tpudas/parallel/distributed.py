@@ -27,11 +27,13 @@ def initialize_multihost(
     global _initialized
     if _initialized:
         return False
-    coordinator_address = coordinator_address or os.environ.get(
-        "COORDINATOR_ADDRESS"
-    )
-    num_processes = num_processes or os.environ.get("NUM_PROCESSES")
-    process_id = process_id or os.environ.get("PROCESS_ID")
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("COORDINATOR_ADDRESS")
+    if num_processes is None:
+        num_processes = os.environ.get("NUM_PROCESSES")
+    # NB: `process_id or env` would drop process 0 — the coordinator
+    if process_id is None:
+        process_id = os.environ.get("PROCESS_ID")
     if not coordinator_address or num_processes is None or process_id is None:
         return False
     jax.distributed.initialize(

@@ -1,17 +1,61 @@
 """Waterfall (raster) QC plots.
 
-``waterfall_plot`` keeps the reference's exact signature and behavior
-(lf_das.py:110-178): bounds validation that prints and returns, a 95th-
-percentile symmetric clip, seismic colormap, measured-depth extent
-``(ch + ch_start) * spacing - surface_fiber``, 600-dpi JPEG output.
-``patch_waterfall`` backs ``Patch.viz.waterfall(scale=...)``
-(low_pass_dascore.ipynb cell 22)."""
+``waterfall_plot`` keeps the reference's signature and observable
+behavior (lf_das.py:110-178: bounds validation that prints and returns,
+95th-percentile symmetric clip, seismic colormap, measured-depth extent
+``(ch + ch_start) * spacing - surface_fiber``, 600-dpi JPEG) but is
+built from this module's own raster helpers, shared with
+``patch_waterfall`` — the Patch-native QC plot behind
+``Patch.viz.waterfall(scale=...)`` (low_pass_dascore.ipynb cell 22),
+which draws a real datetime x-axis.
+"""
 
 from __future__ import annotations
 
 import numpy as np
 
 __all__ = ["waterfall_plot", "patch_waterfall"]
+
+
+def _symmetric_clip(data, percentile=95.0):
+    """Symmetric color limits at the given percentile of |data|."""
+    finite = np.abs(data[np.isfinite(data)])
+    if finite.size == 0:
+        return (-1.0, 1.0)
+    v = float(np.percentile(finite, percentile))
+    return (-v, v)
+
+
+def _raster(ax, block, extent, clim, cmap="seismic"):
+    """The one imshow call both QC plots share: row-major block, no
+    resampling, symmetric limits."""
+    return ax.imshow(
+        block,
+        aspect="auto",
+        interpolation="none",
+        cmap=cmap,
+        extent=extent,
+        vmin=clim[0],
+        vmax=clim[1],
+    )
+
+
+def _validate_window(data, min_sec, max_sec, min_ch, max_ch, sample_rate):
+    """The reference's print-and-return input guard; returns an error
+    string (exact reference wording — notebooks see these messages) or
+    None when the window is plottable."""
+    n_ch, n_t = data.shape
+    if min_sec >= max_sec or min_sec < 0 or max_sec * sample_rate > n_t:
+        return (
+            f"ERROR in plotSpaceTime inputs minSec: {min_sec} "
+            f"or maxSec: {max_sec}"
+        )
+    if min_ch >= max_ch or min_ch < 0 or max_ch > n_ch:
+        return (
+            f"Error in plotSpaceTime inputs minCh: {min_ch} "
+            f"or maxCh: {max_ch} referring to array with {n_ch} channels."
+        )
+    return None
 
 
 def waterfall_plot(
@@ -32,61 +76,38 @@ def waterfall_plot(
     import matplotlib.pyplot as plt
 
     some_data = np.asarray(some_data)
-    if (
-        (min_sec >= max_sec)
-        or (min_sec < 0)
-        or (max_sec * sample_rate > some_data.shape[1])
-    ):
-        print(
-            "ERROR in plotSpaceTime inputs minSec: "
-            + str(min_sec)
-            + " or maxSec: "
-            + str(max_sec)
-        )
-        return
-    if (min_ch >= max_ch) or (min_ch < 0) or (max_ch > some_data.shape[0]):
-        print(
-            "Error in plotSpaceTime inputs minCh: "
-            + str(min_ch)
-            + " or maxCh: "
-            + str(max_ch)
-            + " referring to array with "
-            + str(some_data.shape[0])
-            + " channels."
-        )
-        return
-
-    sec_lo = int(min_sec * sample_rate)
-    sec_hi = int(max_sec * sample_rate)
-    clip_val = np.percentile(np.absolute(some_data), 95)
-
-    plt.figure(figsize=(12, 8))
-    plt.imshow(
-        some_data[min_ch:max_ch, sec_lo:sec_hi],
-        aspect="auto",
-        interpolation="none",
-        cmap="seismic",
-        extent=(
-            min_sec,
-            max_sec,
-            (max_ch + ch_start) * channel_spacing - surface_fiber,
-            (min_ch + ch_start) * channel_spacing - surface_fiber,
-        ),
-        vmin=-clip_val,
-        vmax=clip_val,
+    error = _validate_window(
+        some_data, min_sec, max_sec, min_ch, max_ch, sample_rate
     )
-    plt.ylabel("MD (ft)", fontsize=10)
-    plt.xlabel("Time (sec)", fontsize=10)
-    plt.title(fig_title, fontsize=14)
-    plt.colorbar().set_label("Strain rate (1/s)", fontsize=10)
-    plt.savefig(f"{fig_dir}/{fig_name}.jpeg", dpi=600, format="jpeg")
+    if error is not None:
+        print(error)
+        return
+
+    # measured depth along the fiber for the y axis
+    def depth(ch):
+        return (ch + ch_start) * channel_spacing - surface_fiber
+
+    sec = slice(int(min_sec * sample_rate), int(max_sec * sample_rate))
+    fig, ax = plt.subplots(figsize=(12, 8))
+    im = _raster(
+        ax,
+        some_data[min_ch:max_ch, sec],
+        extent=(min_sec, max_sec, depth(max_ch), depth(min_ch)),
+        clim=_symmetric_clip(some_data),
+    )
+    ax.set_ylabel("MD (ft)", fontsize=10)
+    ax.set_xlabel("Time (sec)", fontsize=10)
+    ax.set_title(fig_title, fontsize=14)
+    fig.colorbar(im, ax=ax).set_label("Strain rate (1/s)", fontsize=10)
+    fig.savefig(f"{fig_dir}/{fig_name}.jpeg", dpi=600, format="jpeg")
     plt.show()
 
 
 def patch_waterfall(patch, scale=None, ax=None, cmap="seismic", show=False):
-    """Waterfall of a Patch: time on x, distance on y, symmetric color
-    limits. ``scale`` (scalar) clips at ``scale * max|data|``; a (lo,
-    hi) pair sets limits directly."""
+    """Waterfall of a Patch: time on x (real datetimes), distance on y,
+    symmetric color limits. ``scale`` (scalar) clips at
+    ``scale * max|data|``; a (lo, hi) pair sets limits directly."""
+    import matplotlib.dates as mdates
     import matplotlib.pyplot as plt
 
     data = patch.host_data()
@@ -106,17 +127,21 @@ def patch_waterfall(patch, scale=None, ax=None, cmap="seismic", show=False):
         _, ax = plt.subplots(figsize=(12, 8))
     times = patch.coords["time"]
     dists = patch.coords["distance"]
-    im = ax.imshow(
-        data.T,
-        aspect="auto",
-        interpolation="none",
-        cmap=cmap,
-        origin="upper",
-        extent=(0, float(len(times)), float(dists[-1]), float(dists[0])),
-        vmin=lim[0],
-        vmax=lim[1],
+    # a real time extent (matplotlib date floats), not sample counts
+    t_lo, t_hi = (
+        mdates.date2num(np.datetime64(times[0], "us").item()),
+        mdates.date2num(np.datetime64(times[-1], "us").item()),
     )
-    ax.set_xlabel("Time (samples)")
+    im = _raster(
+        ax,
+        data.T,
+        extent=(t_lo, t_hi, float(dists[-1]), float(dists[0])),
+        clim=lim,
+        cmap=cmap,
+    )
+    ax.xaxis_date()
+    ax.figure.autofmt_xdate()
+    ax.set_xlabel("Time")
     ax.set_ylabel("Distance (m)")
     plt.colorbar(im, ax=ax).set_label("Amplitude")
     if show:
