@@ -86,6 +86,9 @@ import numpy as np
 # passes path).  Used only for the analytic MFU estimate.
 _PEAK_FP32 = {"v4": 275e12 / 2, "v5e": 197e12 / 2, "v5p": 459e12 / 2}
 
+# wall seconds the engine shoot-out needs before it is attempted
+_COMPARE_MIN_LEFT = 240
+
 
 def _tail(raw, n=1500):
     if not raw:
@@ -414,7 +417,18 @@ def _child() -> None:
     # Gate on the time ACTUALLY left (remaining was frozen at child
     # launch; the main measurement above may have eaten most of it).
     left = remaining - (time.monotonic() - child_start)
-    if compare and left > 240 and not include_h2d:
+    run_compare = left > _COMPARE_MIN_LEFT and not include_h2d
+    if compare and not run_compare:
+        # a requested-but-skipped compare must be visible in the JSON,
+        # not just absent (round-2 advisor finding)
+        reason = (
+            "include_h2d measures the tunnel, not the engines"
+            if include_h2d
+            else f"budget: {left:.0f}s left < {_COMPARE_MIN_LEFT}s"
+        )
+        result["engines_skipped"] = reason
+        print(f"[bench] compare skipped: {reason}", file=sys.stderr, flush=True)
+    if compare and run_compare:
         cmp_iters = max(4, iters // 4)
         if engine == "cascade":
             primary = "cascade-pallas" if use_pallas else "cascade-xla"

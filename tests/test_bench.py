@@ -1,0 +1,74 @@
+"""bench.py child-process logic, run in-process on the CPU backend
+with tiny shapes: the JSON contract must stay parseable and honest
+(requested-but-skipped compares recorded, engines map when budget
+allows)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def _run_child(monkeypatch, capsys, **env):
+    defaults = {
+        "BENCH_CHILD": "1",
+        "BENCH_T": "4096",
+        "BENCH_C": "32",
+        "BENCH_ITERS": "2",
+        "BENCH_ENGINE": "cascade",
+    }
+    defaults.update(env)
+    for k, v in defaults.items():
+        monkeypatch.setenv(k, str(v))
+    bench._child()
+    lines = [
+        ln for ln in capsys.readouterr().out.splitlines() if ln.startswith("{")
+    ]
+    assert lines, "child printed no JSON line"
+    return json.loads(lines[-1])
+
+
+class TestCompareVisibility:
+    def test_budget_skipped_compare_is_recorded(self, monkeypatch, capsys):
+        """BENCH_COMPARE=1 with no budget left must say so in the JSON,
+        not silently omit the engines map (round-2 advisor finding)."""
+        result = _run_child(
+            monkeypatch, capsys, BENCH_COMPARE="1", BENCH_REMAINING="0"
+        )
+        assert "engines" not in result
+        assert "budget" in result["engines_skipped"]
+
+    def test_h2d_skipped_compare_is_recorded(self, monkeypatch, capsys):
+        result = _run_child(
+            monkeypatch,
+            capsys,
+            BENCH_COMPARE="1",
+            BENCH_INCLUDE_H2D="1",
+            BENCH_REMAINING="100000",
+        )
+        assert "engines" not in result
+        assert "h2d" in result["engines_skipped"]
+
+    def test_compare_runs_all_engines_when_budget_allows(
+        self, monkeypatch, capsys
+    ):
+        result = _run_child(
+            monkeypatch, capsys, BENCH_COMPARE="1", BENCH_REMAINING="100000"
+        )
+        engines = result["engines"]
+        assert set(engines) == {"cascade-xla", "cascade-pallas", "fft"}
+        for name, value in engines.items():
+            assert isinstance(value, (int, float)), (name, value)
+        assert "engines_skipped" not in result
+
+    def test_no_compare_no_keys(self, monkeypatch, capsys):
+        result = _run_child(monkeypatch, capsys, BENCH_COMPARE="0")
+        assert "engines" not in result
+        assert "engines_skipped" not in result
+        assert result["value"] > 0
+        assert result["metric"] == "channel_samples_per_sec"

@@ -337,6 +337,28 @@ class TestEndToEnd:
         with pytest.raises(ValueError, match="on_gap"):
             lfp.update_processing_parameter(on_gap="bogus")
 
+    def test_split_no_coverage_warns_loudly(self, spool_dir, tmp_path,
+                                            capsys):
+        # a split run whose range holds no data at all must say so —
+        # silently completing looks like a successful run (round-2
+        # advisor finding)
+        lfp = LFProc(spool(spool_dir).sort("time").update())
+        lfp.update_processing_parameter(
+            output_sample_interval=DT_OUT,
+            process_patch_size=60,
+            edge_buff_size=10,
+            on_gap="split",
+        )
+        out = tmp_path / "empty"
+        lfp.set_output_folder(str(out), delete_existing=True)
+        lfp.process_time_range(
+            np.datetime64("2024-01-01T00:00:00"),  # a year off the data
+            np.datetime64("2024-01-01T00:02:00"),
+        )
+        captured = capsys.readouterr().out
+        assert "no data coverage" in captured
+        assert not [f for f in os.listdir(out) if f.endswith(".h5")]
+
     def test_split_mode_invalid_patch_buff_raises(self, spool_dir,
                                                   tmp_path):
         # an invalid global config must fail loudly, not be swallowed

@@ -42,6 +42,48 @@ class TestClamp:
         assert clamp_poll_interval(10, 5, 40) == 120.0
 
 
+class TestCoveredWorkload:
+    def test_nan_index_cells_degrade_not_crash(self):
+        """A legacy/heterogeneous index row with NaN ntime/ndistance
+        must degrade the round metric to zero samples for that file,
+        never crash the processing loop (round-2 advisor finding)."""
+        import pandas as pd
+
+        from tpudas.proc.streaming import _covered_workload
+
+        t0 = np.datetime64("2023-03-22T00:00:00")
+        contents = pd.DataFrame(
+            [
+                {
+                    "time_min": t0,
+                    "time_max": t0 + np.timedelta64(30, "s"),
+                    "ntime": 3000,
+                    "ndistance": 6,
+                },
+                {
+                    "time_min": t0 + np.timedelta64(30, "s"),
+                    "time_max": t0 + np.timedelta64(60, "s"),
+                    "ntime": float("nan"),
+                    "ndistance": float("nan"),
+                },
+                {
+                    "time_min": t0 + np.timedelta64(60, "s"),
+                    "time_max": t0 + np.timedelta64(90, "s"),
+                    "ntime": None,
+                    "ndistance": 6,
+                },
+            ]
+        )
+        data_sec, samples = _covered_workload(
+            contents, t0, t0 + np.timedelta64(90, "s")
+        )
+        assert np.isfinite(samples)
+        int(samples)  # what the realtime loop does with it
+        assert data_sec == 90.0
+        # only the well-formed first file contributes samples
+        assert samples == pytest.approx(30.0 * (2999 / 30.0) * 6)
+
+
 class TestLowpassRealtime:
     def test_rounds_resume_and_terminate(self, tmp_path):
         src = str(tmp_path / "raw")

@@ -167,6 +167,71 @@ class TestSpoolIntegration:
         sp = spool(str(tmp_path)).update()
         assert len(sp) == 2
 
+    def test_torn_file_rejected_then_indexed_when_complete(self, tmp_path):
+        """A file whose payload is shorter than the header promises (an
+        interrogator mid-write / torn copy) is rejected at scan time —
+        not surfaced as a short read at window-assembly time — and is
+        picked up once its bytes settle."""
+        make_synthetic_spool(
+            tmp_path, n_files=1, file_duration=10.0, fs=50.0, n_ch=4,
+            format="tdas",
+        )
+        (name,) = [p for p in os.listdir(tmp_path) if p.endswith(".tdas")]
+        full = (tmp_path / name).read_bytes()
+        torn = tmp_path / "torn.tdas"
+        torn.write_bytes(full[: len(full) - 128])
+        with pytest.raises(ValueError, match="size mismatch"):
+            scan_file(str(torn), format="tdas")
+        sp = spool(str(tmp_path)).update()
+        assert len(sp) == 1  # torn file skipped, valid one indexed
+        torn.write_bytes(full)  # "interrogator finished writing"
+        assert len(spool(str(tmp_path)).update()) == 2
+
+    def test_scan_carries_exact_dx(self, tmp_path):
+        """Scan records carry the header's exact dx: reconstructing it
+        from (distance_max - d0)/(n-1) is ulp-inexact and moves exact
+        channel-boundary selects (round-2 advisor finding)."""
+        patch = synthetic_patch(
+            duration=10.0, fs=50.0, n_ch=49, d_ch=0.1
+        )
+        path = str(tmp_path / "a.tdas")
+        write_patch(patch, path, format="tdas")
+        hdr = tdas.read_tdas_header(path)
+        rec = scan_file(path, format="tdas")[0]
+        assert rec["dx"] == hdr["dx"]
+        recon = (rec["distance_max"] - rec["distance_min"]) / (
+            rec["ndistance"] - 1
+        )
+        assert recon != hdr["dx"]  # the reconstruction really is off
+
+    def test_plan_channel_bounds_match_reader_on_exact_boundary(
+        self, tmp_path
+    ):
+        """A distance select landing exactly on a channel must pick the
+        same channels through the planned fast path as through the
+        per-file reader (byte parity on boundary selects)."""
+        make_synthetic_spool(
+            tmp_path, n_files=2, file_duration=10.0, fs=50.0, n_ch=49,
+            d_ch=0.1, format="tdas",
+        )
+        first = sorted(
+            p for p in os.listdir(tmp_path) if p.endswith(".tdas")
+        )[0]
+        hdr = tdas.read_tdas_header(str(tmp_path / first))
+        dx = hdr["dx"]
+        sel = (3 * dx, 40 * dx)  # k=3 flips under ulp-off dx
+        sp = spool(str(tmp_path)).sort("time").update().select(distance=sel)
+        t_lo = np.datetime64("2023-03-22T00:00:02")
+        t_hi = np.datetime64("2023-03-22T00:00:18")
+        plan = sp.native_window_plan(t_lo, t_hi)
+        assert plan is not None
+        fast = tdas.assemble_window_patch(plan)
+        merged = spool(sp.select(time=(t_lo, t_hi))).chunk(time=None)[0]
+        assert np.array_equal(fast.host_data(), merged.host_data())
+        assert np.array_equal(
+            fast.coords["distance"], merged.coords["distance"]
+        )
+
     def test_lfproc_end_to_end_on_tdas(self, tmp_path):
         """The full chunked engine runs unchanged on a native-format
         spool and matches the dasdae-format result exactly."""

@@ -249,8 +249,31 @@ def read_tdas(path, time=None, distance=None, **_):
 
 
 def scan_tdas(path):
-    """Metadata record for the directory index (no payload IO)."""
+    """Metadata record for the directory index (no payload IO).
+
+    Verifies the payload length against the header before trusting the
+    record: tdas is a fixed-layout format, so a file the interrogator is
+    still writing (or a torn copy) has ``size != 64 + n_time*n_ch*es``
+    and raises here — the index skips it and re-scans once its
+    (mtime, size) settles, instead of surfacing a short-read error at
+    window-assembly time.
+
+    The record carries the exact header ``dx`` so downstream planning
+    (:func:`plan_window_from_records`) selects channels with the same
+    float the per-file reader uses — reconstructing ``dx`` from
+    ``(distance_max - d0) / (n - 1)`` is ulp-inexact and breaks byte
+    parity on exact channel-boundary selects. (``distance_min`` already
+    IS the exact header ``d0``.)
+    """
     hdr = read_tdas_header(path)
+    es = _DTYPES[hdr["dtype_code"]]().itemsize
+    expected = _HEADER_SIZE + hdr["n_time"] * hdr["n_ch"] * es
+    actual = os.path.getsize(path)
+    if actual != expected:
+        raise ValueError(
+            f"tdas payload size mismatch for {path}: header promises "
+            f"{expected} bytes, file has {actual} (still being written?)"
+        )
     t0 = np.datetime64(hdr["t0_ns"], "ns")
     dt = np.timedelta64(hdr["dt_ns"], "ns")
     return [
@@ -267,6 +290,7 @@ def scan_tdas(path):
             ),
             "ntime": int(hdr["n_time"]),
             "ndistance": int(hdr["n_ch"]),
+            "dx": float(hdr["dx"]),
         }
     ]
 
@@ -298,13 +322,30 @@ def plan_window_from_records(records, t_lo, t_hi, distance=None):
     nd = int(first["ndistance"])
     d0 = float(first["distance_min"])
     d_max = float(first["distance_max"])
-    dx = (d_max - d0) / (nd - 1) if nd > 1 else 0.0
+
+    def _exact_dx(rec):
+        # prefer the exact header dx carried by the scan record; an
+        # index built before the field existed reconstructs it (and
+        # may be a ulp off on boundary selects — re-index to fix)
+        v = rec.get("dx")
+        if v is not None and np.isfinite(v):
+            return float(v)
+        n = int(rec["ndistance"])
+        return (
+            (float(rec["distance_max"]) - float(rec["distance_min"]))
+            / (n - 1)
+            if n > 1
+            else 0.0
+        )
+
+    dx = _exact_dx(first)
     for r in recs:
         if (
             np.timedelta64(r["time_step"], "ns").astype(np.int64) != dt_ns
             or int(r["ndistance"]) != nd
             or float(r["distance_min"]) != d0
             or float(r["distance_max"]) != d_max
+            or _exact_dx(r) != dx
         ):
             return None
     c_lo, c_hi = _ch_range(

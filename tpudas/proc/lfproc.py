@@ -312,6 +312,21 @@ class LFProc:
                     f"2*edge_buff_size ({2 * buff_size})"
                 )
             segments = self._split_grid_at_gaps(time_grid)
+            if not segments:
+                # completing silently here would look exactly like a
+                # successful run with output — say loudly that nothing
+                # in [bg, ed) was processable
+                print(
+                    "Warning: no data coverage found in "
+                    f"[{bgtime} .. {edtime}) — nothing was processed "
+                    "(on_gap='split')"
+                )
+                log_event(
+                    "split_no_coverage",
+                    bgtime=str(bgtime),
+                    edtime=str(edtime),
+                    grid_points=len(time_grid),
+                )
         else:
             segments = [(0, len(time_grid))]
         total_windows = 0

@@ -32,6 +32,17 @@ from tpudas.utils.profiling import Counters
 __all__ = ["clamp_poll_interval", "run_lowpass_realtime", "run_rolling_realtime"]
 
 
+def _finite(value) -> float:
+    """Coerce an index cell to a finite float (0.0 for None/NaN/junk) —
+    a heterogeneous or legacy index row must degrade the metric, never
+    crash the processing loop."""
+    try:
+        v = float(value)
+    except (TypeError, ValueError):
+        return 0.0
+    return v if math.isfinite(v) else 0.0
+
+
 def _covered_workload(contents, t1, t2):
     """(data_seconds, channel_samples) actually present in the index
     within [t1, t2) — gaps and heterogeneous files are accounted per
@@ -49,10 +60,10 @@ def _covered_workload(contents, t1, t2):
         if ov_ns <= 0:
             continue
         data_ns += ov_ns
-        n_time = float(row.get("ntime") or 0)
+        n_time = _finite(row.get("ntime"))
         if span_ns > 0 and n_time > 1:
             fs = (n_time - 1) / (span_ns / 1e9)
-            samples += ov_ns / 1e9 * fs * float(row.get("ndistance") or 0)
+            samples += ov_ns / 1e9 * fs * _finite(row.get("ndistance"))
     return data_ns / 1e9, samples
 
 
