@@ -352,6 +352,7 @@ def _e2e_child(backend: str) -> None:
                 "mode": "e2e",
                 "shape": [int(sec * fs), C],
                 "native_windows": lfp.native_windows,
+                "engine_counts": lfp.engine_counts,
                 "output_files": n_out,
             }
         )

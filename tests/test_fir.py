@@ -163,6 +163,49 @@ class TestPallasKernel:
             fir_decimate_pallas(x, hb, 2, n_out=64, interpret=True)
 
 
+class TestStageEngines:
+    def test_decision_matches_build_predicate(self):
+        from tpudas.ops.fir import design_cascade, stage_engines
+
+        plan = design_cascade(1000.0, 1000, 0.45, 4)
+        # big shapes: the full-rate stages qualify for the Pallas kernel
+        eng = stage_engines(plan, 128, 2048, engine="pallas")
+        assert eng[0] == "pallas", eng
+        # tiny shapes never do; forced-xla never does
+        assert set(stage_engines(plan, 4, 8, engine="pallas")) == {"xla"}
+        assert set(stage_engines(plan, 128, 2048, engine="xla")) == {"xla"}
+        # 'auto' resolves by backend: CPU under the test conftest
+        assert set(stage_engines(plan, 128, 2048)) == {"xla"}
+
+    def test_lfproc_engine_counts_ground_truth(self, tmp_path):
+        """LFProc.engine_counts reports what actually ran, without the
+        log handler — config 'auto' on CPU runs cascade-xla windows."""
+        from tpudas import spool
+        from tpudas.proc.lfproc import LFProc
+        from tpudas.testing import make_synthetic_spool
+
+        d = tmp_path / "raw"
+        make_synthetic_spool(
+            d, n_files=6, file_duration=30.0, fs=100.0, n_ch=6, noise=0.01
+        )
+        for engine, expect_key in (("auto", "cascade-xla"), ("fft", "fft")):
+            lfp = LFProc(spool(str(d)).sort("time").update())
+            lfp.update_processing_parameter(
+                output_sample_interval=1.0,
+                process_patch_size=60,
+                edge_buff_size=10,
+                engine=engine,
+            )
+            out = tmp_path / f"counts_{engine}"
+            lfp.set_output_folder(str(out), delete_existing=True)
+            lfp.process_time_range(
+                np.datetime64("2023-03-22T00:00:00"),
+                np.datetime64("2023-03-22T00:03:00"),
+            )
+            assert lfp.engine_counts[expect_key] == 4, lfp.engine_counts
+            assert sum(lfp.engine_counts.values()) == 4
+
+
 class TestLFProcEngines:
     def test_cascade_equals_fft_engine(self, tmp_path):
         """Full chunked runs with engine='fft' vs engine='cascade' agree

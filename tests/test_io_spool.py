@@ -56,6 +56,69 @@ class TestDasdaeIO:
             p.io.write(str(tmp_path / "x.h5"), "not_a_format")
 
 
+class TestFormatSniffing:
+    def test_read_file_sniffs_each_format(self, tmp_path):
+        from tpudas.io.registry import sniff_format
+
+        p = synthetic_patch(duration=5, fs=100.0, n_ch=4, noise=0.1)
+        # extensions deliberately lie: sniffing must go by magic bytes
+        h5_path = str(tmp_path / "mislabeled.dat")
+        tdas_path = str(tmp_path / "other.bin")
+        write_patch(p, h5_path, format="dasdae")
+        write_patch(p, tdas_path, format="tdas")
+        assert sniff_format(h5_path) == "dasdae"
+        assert sniff_format(tdas_path) == "tdas"
+        for path in (h5_path, tdas_path):
+            q = read_file(path)[0]
+            assert np.allclose(q.host_data(), p.host_data(), atol=1e-6)
+
+    def test_spool_on_single_tdas_file(self, tmp_path):
+        # dc.spool(path) accepts any supported file (SURVEY.md §2.3);
+        # before sniffing, a .tdas file was parsed as HDF5 and failed
+        p = synthetic_patch(duration=5, fs=100.0, n_ch=4)
+        path = str(tmp_path / "one.tdas")
+        write_patch(p, path, format="tdas")
+        sp = spool(path)
+        assert len(sp) == 1
+        assert np.array_equal(sp[0].host_data(), p.host_data())
+
+    def test_unsniffable_file_raises(self, tmp_path):
+        junk = tmp_path / "junk.bin"
+        junk.write_bytes(b"definitely not a DAS file")
+        with pytest.raises(ValueError, match="magic bytes"):
+            read_file(str(junk))
+
+    def test_scan_file_sniffs(self, tmp_path):
+        p = synthetic_patch(duration=5, fs=100.0, n_ch=4)
+        path = str(tmp_path / "x.tdas")
+        write_patch(p, path, format="tdas")
+        assert scan_file(path)[0]["format"] == "tdas"
+
+    def test_reregister_replaces_sniffer(self, tmp_path):
+        from tpudas.io import registry
+
+        before = list(registry._SNIFFERS)
+        try:
+            reader = lambda path, **kw: []  # noqa: E731
+            registry.register_format(
+                "fmtx", reader, None, None, sniff=lambda head: False
+            )
+            # a corrected predicate must REPLACE the old one, not queue
+            # behind it in first-match-wins order
+            registry.register_format(
+                "fmtx", reader, None, None,
+                sniff=lambda head: head[:4] == b"FMTX",
+            )
+            names = [n for n, _ in registry._SNIFFERS]
+            assert names.count("fmtx") == 1
+            probe = tmp_path / "probe.bin"
+            probe.write_bytes(b"FMTX rest of file")
+            assert registry.sniff_format(str(probe)) == "fmtx"
+        finally:
+            registry._SNIFFERS[:] = before
+            registry._FORMATS.pop("fmtx", None)
+
+
 class TestDirectorySpool:
     def test_update_and_len(self, spool_dir):
         sp = spool(spool_dir).sort("time").update()

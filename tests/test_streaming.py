@@ -36,10 +36,15 @@ def _append_files(directory, start_index, count):
 
 class TestClamp:
     def test_reference_cadence_guard(self):
-        # max(125, file_len); then >= 3x edge buffer
+        # max(125, requested, file_len, 3x edge buffer) — the 125 s
+        # floor is absolute (low_pass_dascore_edge.ipynb:165-173), even
+        # when the caller requests a faster cadence
         assert clamp_poll_interval(125, 30, 10) == 125
         assert clamp_poll_interval(125, 300, 10) == 300
-        assert clamp_poll_interval(10, 5, 40) == 120.0
+        assert clamp_poll_interval(10, 5, 40) == 125.0
+        assert clamp_poll_interval(5, 1, 1) == 125.0
+        assert clamp_poll_interval(500, 30, 10) == 500.0
+        assert clamp_poll_interval(10, 30, 60) == 180.0
 
 
 class TestCoveredWorkload:
@@ -184,14 +189,22 @@ class TestLowpassRealtime:
             set_log_handler(None)
         assert rounds >= 1
         # ground truth: the cascade engine actually ran the windows
+        # (window_engine now names the sub-engine: cascade-xla on CPU)
         ran = [e for e in events if e["event"] == "window_engine"]
-        assert ran and all(e["engine"] == "cascade" for e in ran)
+        assert ran and all(e["engine"] == "cascade-xla" for e in ran)
         # per-round real-time factor is reported and accumulated
         rts = [
             e for e in events if e["event"] == "realtime_round"
         ]
         assert rts and all(e["realtime_factor"] > 0 for e in rts)
         assert all(e["engine"] == "cascade" for e in rts)
+        # engine_counts ride along each round event (ground truth for
+        # operators without the log handler)
+        assert all(
+            sum(e["engine_counts"].values()) > 0
+            and e["engine_counts"]["fft"] == 0
+            for e in rts
+        )
         assert counters.realtime_factor > 0
         assert counters.wall_seconds > 0
 

@@ -167,6 +167,53 @@ class TestSpoolIntegration:
         sp = spool(str(tmp_path)).update()
         assert len(sp) == 2
 
+    def test_legacy_cache_version_discarded_and_rescanned(self, tmp_path):
+        """A pre-dx index cache (version 1) must be discarded whole on
+        load: mixed legacy/new records would fail the planner's
+        geometry check and silently disable the native fast path."""
+        import json
+
+        from tpudas.io.index import INDEX_FILENAME, DirectoryIndex
+
+        make_synthetic_spool(
+            tmp_path, n_files=2, file_duration=10.0, fs=50.0, n_ch=8,
+            d_ch=0.1, format="tdas",
+        )
+        DirectoryIndex(str(tmp_path)).update()
+        cache = tmp_path / INDEX_FILENAME
+        raw = json.loads(cache.read_text())
+        # fabricate the legacy cache: version 1, no dx field
+        raw["version"] = 1
+        for rec in raw["files"].values():
+            rec.pop("dx", None)
+        cache.write_text(json.dumps(raw))
+        sp = spool(str(tmp_path)).sort("time").update()
+        df = sp.get_contents()
+        assert len(df) == 2
+        assert all(np.isfinite(v) for v in df["dx"])  # rescanned
+        plan = sp.native_window_plan(
+            np.datetime64("2023-03-22T00:00:02"),
+            np.datetime64("2023-03-22T00:00:18"),
+        )
+        assert plan is not None  # fast path alive across the upgrade
+
+    def test_truncated_indexed_file_record_dropped(self, tmp_path):
+        """A file that was indexed complete and later truncated in
+        place must lose its (now stale) index record — not serve a
+        short read at window-assembly time."""
+        make_synthetic_spool(
+            tmp_path, n_files=2, file_duration=10.0, fs=50.0, n_ch=4,
+            format="tdas",
+        )
+        sp = spool(str(tmp_path)).update()
+        assert len(sp) == 2
+        victim = sorted(tmp_path.glob("*.tdas"))[0]
+        full = victim.read_bytes()
+        victim.write_bytes(full[: len(full) - 64])  # truncate in place
+        assert len(spool(str(tmp_path)).update()) == 1
+        victim.write_bytes(full)  # writer finishes: record returns
+        assert len(spool(str(tmp_path)).update()) == 2
+
     def test_torn_file_rejected_then_indexed_when_complete(self, tmp_path):
         """A file whose payload is shorter than the header promises (an
         interrogator mid-write / torn copy) is rejected at scan time —

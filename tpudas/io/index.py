@@ -84,11 +84,22 @@ class DirectoryIndex:
     def cache_path(self) -> str:
         return os.path.join(self.directory, INDEX_FILENAME)
 
+    # bump when scan records gain fields the planner depends on (v2:
+    # exact tdas "dx"); a cache of any other version is discarded whole
+    # so every file is rescanned — header-only reads, cheap — instead
+    # of old and new records coexisting (a mixed set would fail the
+    # planner's geometry-equality check and silently disable the
+    # native fast path forever)
+    CACHE_VERSION = 2
+
     def _load_cache(self):
         self._loaded_cache = True
         try:
             with open(self.cache_path) as fh:
                 raw = json.load(fh)
+            if raw.get("version") != self.CACHE_VERSION:
+                self._records = {}
+                return
             self._records = {
                 k: _record_from_json(v) for k, v in raw.get("files", {}).items()
             }
@@ -97,7 +108,7 @@ class DirectoryIndex:
 
     def _save_cache(self):
         payload = {
-            "version": 1,
+            "version": self.CACHE_VERSION,
             "files": {k: _record_to_json(v) for k, v in self._records.items()},
         }
         try:
@@ -139,7 +150,15 @@ class DirectoryIndex:
             try:
                 info = scan_file(path, format=fmt)[0]
             except (OSError, ValueError):
-                continue  # unreadable / foreign / partially-written file
+                # unreadable / foreign / partially-written file: a STALE
+                # record for it must go too — the file's bytes no longer
+                # match what the record promises (e.g. truncated in
+                # place), and serving it would surface a short read at
+                # window-assembly time
+                if rec is not None:
+                    del self._records[name]
+                    changed = True
+                continue
             info["mtime"] = st.st_mtime
             info["size"] = st.st_size
             info.pop("shape", None)

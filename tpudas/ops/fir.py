@@ -54,6 +54,8 @@ __all__ = [
     "impulse_response",
     "edge_support_samples",
     "butter2_mag",
+    "resolve_cascade_engine",
+    "stage_engines",
 ]
 
 
@@ -288,6 +290,49 @@ def _block_taps(h: np.ndarray, R: int) -> np.ndarray:
     return hp.reshape(B, R)
 
 
+def _stage_counts(plan: CascadePlan, n_out: int) -> list[int]:
+    """Required output count per stage: a stage producing n outputs
+    with B tap-frames consumes (n + B) * R input samples."""
+    counts = [n_out]
+    for R, h in reversed(plan.stages[1:]):
+        counts.append((counts[-1] + (-(-len(h) // R))) * R)
+    counts.reverse()
+    return counts
+
+
+def _pallas_stage_ok(k: int, R: int, n_ch: int, n_frames: int) -> bool:
+    """Pallas only for stages that are both big enough to matter and
+    whose taps fit the kernel's 128-frame block; very long single-stage
+    plans (possible via the public design API) take the XLA polyphase
+    path instead of erroring."""
+    return k * R * n_ch >= (1 << 21) and n_frames <= 128
+
+
+def resolve_cascade_engine(engine: str = "auto") -> str:
+    """'auto' -> 'pallas' on TPU backends, 'xla' elsewhere."""
+    if engine == "auto":
+        import jax
+
+        return "pallas" if jax.default_backend() in ("tpu", "axon") else "xla"
+    return engine
+
+
+def stage_engines(
+    plan: CascadePlan, n_out: int, n_ch: int, engine: str = "auto"
+) -> list[str]:
+    """Which engine each stage will actually run under — the same
+    decision :func:`_build_cascade_fn` makes at trace time, exposed so
+    callers (LFProc observability, the bench) can report ground truth
+    instead of the configured intent."""
+    engine = resolve_cascade_engine(engine)
+    out = []
+    for (R, h), k in zip(plan.stages, _stage_counts(plan, int(n_out))):
+        B = -(-len(h) // int(R))
+        use = engine == "pallas" and _pallas_stage_ok(k, int(R), int(n_ch), B)
+        out.append("pallas" if use else "xla")
+    return out
+
+
 @functools.lru_cache(maxsize=64)
 def _build_cascade_fn(plan: CascadePlan, n_out: int, engine: str):
     """jit-compiled causal cascade: x (T, C) -> (n_out, C)."""
@@ -297,12 +342,7 @@ def _build_cascade_fn(plan: CascadePlan, n_out: int, engine: str):
     blocked = [
         (R, jnp.asarray(_block_taps(np.asarray(h), R))) for R, h in plan.stages
     ]
-    # required output count per stage, back to front: a stage producing
-    # n outputs with B tap-frames consumes (n + B) * R input samples
-    counts = [n_out]
-    for R, h in reversed(plan.stages[1:]):
-        counts.append((counts[-1] + (-(-len(h) // R))) * R)
-    counts.reverse()
+    counts = _stage_counts(plan, n_out)
 
     use_pallas = engine == "pallas"
     if use_pallas:
@@ -315,14 +355,8 @@ def _build_cascade_fn(plan: CascadePlan, n_out: int, engine: str):
     def fn(x):
         x = x.astype(jnp.float32)
         for (R, hb), k in zip(blocked, counts):
-            # Pallas only for stages that are both big enough to matter
-            # and whose taps fit the kernel's 128-frame block; very long
-            # single-stage plans (possible via the public design API)
-            # take the XLA polyphase path instead of erroring
-            if (
-                use_pallas
-                and k * R * x.shape[1] >= (1 << 21)
-                and hb.shape[0] <= 128
+            if use_pallas and _pallas_stage_ok(
+                k, R, x.shape[1], hb.shape[0]
             ):
                 x = fir_decimate_pallas(x, hb, R, n_out=k, interpret=interpret)
             else:
@@ -345,12 +379,7 @@ def cascade_decimate(x, plan: CascadePlan, phase: int, n_out: int, engine="auto"
     """
     import jax.numpy as jnp
 
-    if engine == "auto":
-        import jax
-
-        engine = (
-            "pallas" if jax.default_backend() in ("tpu", "axon") else "xla"
-        )
+    engine = resolve_cascade_engine(engine)
     x = jnp.asarray(x)
     shift = int(phase) - plan.delay
     if shift >= 0:

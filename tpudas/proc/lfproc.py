@@ -150,6 +150,12 @@ class LFProc:
         # windows ingested via the native tdas assembler (observability:
         # lets tests and ops confirm the fast path is actually taken)
         self.native_windows = 0
+        # per-window count of the engine that ACTUALLY ran (config may
+        # say "auto"; operators and the e2e bench need the ground truth
+        # without enabling the log handler): a cascade window counts as
+        # "cascade-pallas" when any of its stages ran the Pallas kernel,
+        # "cascade-xla" otherwise; FFT-path windows count as "fft"
+        self.engine_counts = {"cascade-pallas": 0, "cascade-xla": 0, "fft": 0}
 
     # configuration ----------------------------------------------------
     def _default_process_parameters(self):
@@ -501,10 +507,22 @@ class LFProc:
                 else:
                     align = None  # auto: fall back to the FFT engine
         # observability: which engine actually ran this window (config
-        # says "auto"/"cascade"; this event is the ground truth)
+        # says "auto"/"cascade"; this count/event is the ground truth)
+        if align is not None:
+            from tpudas.ops.fir import stage_engines
+
+            stages = stage_engines(
+                plan, int(target_times.size), int(host.shape[1])
+            )
+            ran = (
+                "cascade-pallas" if "pallas" in stages else "cascade-xla"
+            )
+        else:
+            ran = "fft"
+        self.engine_counts[ran] += 1
         log_event(
             "window_engine",
-            engine="cascade" if align is not None else "fft",
+            engine=ran,
             rows=int(host.shape[0]),
             emitted=int(target_times.size),
         )

@@ -67,13 +67,23 @@ def _covered_workload(contents, t1, t2):
     return data_ns / 1e9, samples
 
 
+POLL_FLOOR_SEC = 125.0
+
+
 def clamp_poll_interval(requested, file_duration, edge_buffer):
-    """The reference's cadence guard: never poll faster than one file's
-    duration or 1.5x the two-sided edge buffer."""
-    interval = max(float(requested), float(file_duration))
-    if interval < 2 * edge_buffer * 1.5:
-        interval = 2 * edge_buffer * 1.5
-    return interval
+    """The reference's cadence guard
+    (low_pass_dascore_edge.ipynb:165-173): the poll interval is
+    ``max(125 s, file duration, 3 * edge buffer)`` — and never faster
+    than requested. The absolute 125 s floor is unconditional; it
+    bounds the chance of reading a file the interrogator is still
+    mid-writing (the only race surface in the crash-only design).
+    Tests inject ``sleep_fn`` rather than lowering the clamp."""
+    return max(
+        float(requested),
+        POLL_FLOOR_SEC,
+        float(file_duration),
+        3.0 * float(edge_buffer),
+    )
 
 
 def run_lowpass_realtime(
@@ -187,6 +197,7 @@ def run_lowpass_realtime(
                 wall_seconds=round(counters.last_wall, 4),
                 realtime_factor=round(round_rt, 2),
                 engine=lfp.parameters["engine"],
+                engine_counts=dict(lfp.engine_counts),
                 native_windows=lfp.native_windows,
             )
             if on_round is not None:
