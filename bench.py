@@ -237,7 +237,8 @@ def _build_fft_step(T, C, fs, dt_out, order):
     return kernel, flops
 
 
-def _build_cascade_step(T, C, fs, dt_out, order, use_pallas):
+def _build_cascade_step(T, C, fs, dt_out, order, use_pallas, mesh=None,
+                        time_shards=1):
     from tpudas.ops.fir import _build_cascade_fn, design_cascade
 
     corner = 1.0 / dt_out / 2.0 * 0.9
@@ -247,7 +248,31 @@ def _build_cascade_step(T, C, fs, dt_out, order, use_pallas):
     # output samples; emitted sample 0 sits ratio*buff inside the
     # window. delay alignment is free (slice), included in the timing.
     n_out = T // ratio
-    fn = _build_cascade_fn(plan, n_out, "pallas" if use_pallas else "xla")
+    engine = "pallas" if use_pallas else "xla"
+    if mesh is not None and time_shards > 1:
+        from tpudas.parallel.pipeline import sharded_cascade_decimate
+
+        def fn(data):
+            out = sharded_cascade_decimate(
+                mesh, data, plan, plan.delay, n_out, engine=engine
+            )
+            if out is None:
+                raise ValueError(
+                    f"time_shards={time_shards} does not fit this "
+                    f"window/filter (T={T}); lower BENCH_TIME_SHARDS"
+                )
+            return out
+    elif mesh is not None:
+        from tpudas.ops.fir import cascade_decimate
+
+        # cascade_decimate's mesh wrapper pads C to the shard multiple
+        # (phase=delay -> zero pre-shift, same as the direct fn)
+        def fn(data):
+            return cascade_decimate(
+                data, plan, plan.delay, n_out, engine, mesh=mesh
+            )
+    else:
+        fn = _build_cascade_fn(plan, n_out, engine)
 
     # per stage: a polyphase FIR producing T/prod(R) samples from
     # `taps` MACs each -> 2*taps flops per output sample per channel
@@ -386,9 +411,27 @@ def _child() -> None:
     print(f"[bench] child backend={backend}", file=sys.stderr, flush=True)
 
     fs, dt_out, order = 1000.0, 1.0, 4
+    mesh = None
+    mesh_info = None
+    n_mesh = int(os.environ.get("BENCH_MESH", 0))
+    time_shards = int(os.environ.get("BENCH_TIME_SHARDS", 1))
+    if n_mesh:
+        from tpudas.parallel.mesh import make_mesh
+
+        n_mesh = min(n_mesh, len(jax.devices()))
+        mesh = make_mesh(n_mesh, time_shards=time_shards)
+        mesh_info = dict(mesh.shape)
+        if engine != "cascade":
+            print(
+                "[bench] BENCH_MESH supports the cascade engine only",
+                file=sys.stderr,
+                flush=True,
+            )
+            mesh = None
+            mesh_info = None  # never report a mesh that did not run
     if engine == "cascade":
         kernel, flops_win = _build_cascade_step(
-            T, C, fs, dt_out, order, use_pallas
+            T, C, fs, dt_out, order, use_pallas, mesh, time_shards
         )
     else:
         kernel, flops_win = _build_fft_step(T, C, fs, dt_out, order)
@@ -411,6 +454,8 @@ def _child() -> None:
         "flops_est": round(flops_per_sec / 1e12, 3),
         "flops_unit": "TFLOP/s",
     }
+    if mesh_info is not None:
+        result["mesh"] = mesh_info
     if peak and backend != "cpu":
         result["mfu"] = round(flops_per_sec / peak, 4)
 

@@ -122,3 +122,120 @@ class TestBatchedRolling:
         for b in range(B):
             ref = np.asarray(rolling_reduce(batch[b], w, s, "mean"))
             assert np.allclose(out[b], ref, atol=1e-5, equal_nan=True)
+
+
+class TestShardedCascade:
+    """sharded_cascade_decimate must be bit-equal to the single-device
+    cascade — the halo exchange and shard grid are layout, not math."""
+
+    def _plan(self, fs=100.0, ratio=20):
+        from tpudas.ops.fir import design_cascade
+
+        return design_cascade(fs, ratio, 0.45, 4)
+
+    @pytest.mark.parametrize("time_shards", [1, 2, 4])
+    def test_bit_equal_to_single_device(self, time_shards):
+        from tpudas.ops.fir import cascade_decimate
+        from tpudas.parallel.pipeline import sharded_cascade_decimate
+
+        plan = self._plan()
+        mesh = make_mesh(8, time_shards=time_shards)
+        T, C = 12000, 12  # C=12 not divisible by ch shards: pad path
+        x = _signal(T, C, 100.0, seed=3)
+        phase, n_out = 200, 110
+        ref = np.asarray(cascade_decimate(x, plan, phase, n_out, "xla"))
+        out = sharded_cascade_decimate(mesh, x, plan, phase, n_out)
+        assert out is not None
+        assert np.array_equal(np.asarray(out), ref)
+
+    def test_unfit_layout_returns_none(self):
+        from tpudas.parallel.pipeline import sharded_cascade_decimate
+
+        plan = self._plan()
+        mesh = make_mesh(8, time_shards=8)
+        # tiny window: local blocks far smaller than the filter halo
+        x = _signal(600, 4, 100.0)
+        assert sharded_cascade_decimate(mesh, x, plan, 10, 8) is None
+
+
+class TestLFProcMesh:
+    """The product engine runs mesh-sharded end to end: output files
+    must be byte-identical to the single-device run (VERDICT r3 #2)."""
+
+    def _run(self, src, out_dir, mesh, engine="auto"):
+        from tpudas import spool
+        from tpudas.proc.lfproc import LFProc
+
+        lfp = LFProc(spool(str(src)).sort("time").update(), mesh=mesh)
+        lfp.update_processing_parameter(
+            output_sample_interval=1.0,
+            process_patch_size=60,
+            edge_buff_size=10,
+            engine=engine,
+        )
+        lfp.set_output_folder(str(out_dir), delete_existing=True)
+        lfp.process_time_range(
+            np.datetime64("2023-03-22T00:00:00"),
+            np.datetime64("2023-03-22T00:03:00"),
+        )
+        return lfp
+
+    @pytest.fixture(scope="class")
+    def src(self, tmp_path_factory):
+        from tpudas.testing import make_synthetic_spool
+
+        d = tmp_path_factory.mktemp("mesh_raw")
+        make_synthetic_spool(
+            d, n_files=6, file_duration=30.0, fs=100.0, n_ch=12, noise=0.01
+        )
+        return d
+
+    @pytest.mark.parametrize(
+        "time_shards,engine",
+        [(1, "auto"), (2, "auto"), (4, "auto"), (1, "fft"), (2, "fft")],
+    )
+    def test_sharded_files_byte_identical(
+        self, src, tmp_path, time_shards, engine
+    ):
+        from tpudas import spool
+
+        single = self._run(src, tmp_path / "single", None, engine)
+        mesh = make_mesh(8, time_shards=time_shards)
+        sharded = self._run(src, tmp_path / "sharded", mesh, engine)
+        a = spool(str(tmp_path / "single")).update().chunk(time=None)[0]
+        b = spool(str(tmp_path / "sharded")).update().chunk(time=None)[0]
+        assert np.array_equal(a.host_data(), b.host_data())
+        assert np.array_equal(a.coords["time"], b.coords["time"])
+        # same engines fired, just sharded
+        assert sharded.engine_counts == single.engine_counts
+
+    def test_streaming_driver_takes_mesh(self, src, tmp_path):
+        from tpudas import spool
+        from tpudas.proc.streaming import run_lowpass_realtime
+
+        mesh = make_mesh(8, time_shards=2)
+        out = tmp_path / "rt_out"
+        rounds = run_lowpass_realtime(
+            str(src),
+            str(out),
+            "2023-03-22T00:00:00",
+            output_sample_interval=1.0,
+            edge_buffer=10.0,
+            process_patch_size=60,
+            poll_interval=0.0,
+            sleep_fn=lambda s: None,
+            max_rounds=3,
+            mesh=mesh,
+        )
+        assert rounds >= 1
+        merged = spool(str(out)).update().chunk(time=None)
+        assert len(merged) == 1  # seam-free under the mesh
+
+    def test_mesh_without_ch_axis_rejected(self):
+        from jax.sharding import Mesh
+
+        from tpudas.proc.lfproc import LFProc
+
+        bad = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("a", "b"))
+        with pytest.raises(ValueError, match="'ch' axis"):
+            LFProc(None, mesh=bad)

@@ -300,6 +300,17 @@ def _stage_counts(plan: CascadePlan, n_out: int) -> list[int]:
     return counts
 
 
+def cascade_input_need(plan: CascadePlan, n_out: int) -> int:
+    """Input rows the cascade actually consumes to emit ``n_out``
+    outputs (after the delay pre-shift): the first stage's
+    ``(count + B) * R``. Shorter inputs are zero-padded by the
+    apply path; time-sharded callers size their halo from this."""
+    counts = _stage_counts(plan, int(n_out))
+    R0, h0 = plan.stages[0]
+    B0 = -(-len(h0) // int(R0))
+    return (counts[0] + B0) * int(R0)
+
+
 def _pallas_stage_ok(k: int, R: int, n_ch: int, n_frames: int) -> bool:
     """Pallas only for stages that are both big enough to matter and
     whose taps fit the kernel's 128-frame block; very long single-stage
@@ -333,40 +344,80 @@ def stage_engines(
     return out
 
 
-@functools.lru_cache(maxsize=64)
-def _build_cascade_fn(plan: CascadePlan, n_out: int, engine: str):
-    """jit-compiled causal cascade: x (T, C) -> (n_out, C)."""
-    import jax
+def _apply_cascade_stages(x, blocked, counts, use_pallas, interpret):
+    """Traceable cascade body shared by the jit path and the shard_map
+    (mesh) paths: x (T_local, C_local) -> (counts[-1], C_local)."""
     import jax.numpy as jnp
 
-    blocked = [
-        (R, jnp.asarray(_block_taps(np.asarray(h), R))) for R, h in plan.stages
-    ]
+    x = x.astype(jnp.float32)
+    for (R, hb), k in zip(blocked, counts):
+        if use_pallas and _pallas_stage_ok(k, R, x.shape[1], hb.shape[0]):
+            from tpudas.ops.pallas_fir import fir_decimate_pallas
+
+            x = fir_decimate_pallas(x, hb, R, n_out=k, interpret=interpret)
+        else:
+            x = _polyphase_stage_xla(x, hb, R, k)
+    return x
+
+
+def _blocked_taps(plan: CascadePlan):
+    """Frame-blocked taps as HOST numpy arrays: the apply body may be
+    traced inside an outer jit (e.g. a benchmark step), and a device
+    constant created during one trace must not be cached into another
+    (UnexpectedTracerError) — numpy constants are staged per-trace."""
+    return [(R, _block_taps(np.asarray(h), R)) for R, h in plan.stages]
+
+
+def _pallas_interpret() -> bool:
+    # interpret mode off-TPU so the same code path is testable on
+    # the CPU mesh (SURVEY.md §4 "distributed-without-a-cluster")
+    import jax
+
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+@functools.lru_cache(maxsize=64)
+def _build_cascade_fn(plan: CascadePlan, n_out: int, engine: str, mesh=None,
+                      ch_axis="ch"):
+    """jit-compiled causal cascade: x (T, C) -> (n_out, C).
+
+    With ``mesh``, the cascade runs under ``shard_map`` with channels
+    split over the mesh's ``ch_axis`` — the zero-communication layout
+    (SURVEY.md §2.4): every stage is channel-independent, so each
+    device runs the full cascade (including the Pallas kernel, which
+    GSPMD could not partition through a plain jit) on its local
+    channel block.
+    """
+    import jax
+
+    blocked = _blocked_taps(plan)
     counts = _stage_counts(plan, n_out)
-
     use_pallas = engine == "pallas"
-    if use_pallas:
-        from tpudas.ops.pallas_fir import fir_decimate_pallas
-
-        # interpret mode off-TPU so the same code path is testable on
-        # the CPU mesh (SURVEY.md §4 "distributed-without-a-cluster")
-        interpret = jax.default_backend() not in ("tpu", "axon")
+    interpret = _pallas_interpret() if use_pallas else False
 
     def fn(x):
-        x = x.astype(jnp.float32)
-        for (R, hb), k in zip(blocked, counts):
-            if use_pallas and _pallas_stage_ok(
-                k, R, x.shape[1], hb.shape[0]
-            ):
-                x = fir_decimate_pallas(x, hb, R, n_out=k, interpret=interpret)
-            else:
-                x = _polyphase_stage_xla(x, hb, R, k)
-        return x
+        return _apply_cascade_stages(x, blocked, counts, use_pallas, interpret)
 
+    if mesh is not None:
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        spec = P(None, ch_axis)
+        body = shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(spec,),
+            out_specs=spec,
+            check_vma=False,
+        )
+        return jax.jit(body)
     return jax.jit(fn)
 
 
-def cascade_decimate(x, plan: CascadePlan, phase: int, n_out: int, engine="auto"):
+def cascade_decimate(
+    x, plan: CascadePlan, phase: int, n_out: int, engine="auto",
+    mesh=None, ch_axis="ch",
+):
     """Zero-phase filtered + decimated samples of ``x`` (T, C).
 
     Output ``k`` equals the composite zero-phase filter of ``x``
@@ -376,6 +427,10 @@ def cascade_decimate(x, plan: CascadePlan, phase: int, n_out: int, engine="auto"
     ``phase`` may be any non-negative int; edge regions (within
     ``plan.delay`` of either end) carry the usual truncation artifacts,
     which the overlap-save scheduler trims (SURVEY.md §3.1).
+
+    With ``mesh``, channels are split over the mesh's ``ch_axis``
+    (zero-communication sharding; C is zero-padded to a multiple of the
+    axis size and trimmed after).
     """
     import jax.numpy as jnp
 
@@ -386,8 +441,16 @@ def cascade_decimate(x, plan: CascadePlan, phase: int, n_out: int, engine="auto"
         x2 = x[shift:]
     else:
         x2 = jnp.pad(x, ((-shift, 0), (0, 0)))
-    fn = _build_cascade_fn(plan, int(n_out), engine)
-    return fn(x2)
+    if mesh is None:
+        return _build_cascade_fn(plan, int(n_out), engine)(x2)
+    nc = mesh.shape[ch_axis]
+    C = x2.shape[1]
+    pad_c = -C % nc
+    if pad_c:
+        x2 = jnp.pad(x2, ((0, 0), (0, pad_c)))
+    fn = _build_cascade_fn(plan, int(n_out), engine, mesh, ch_axis)
+    out = fn(x2)
+    return out[:, :C] if pad_c else out
 
 
 # ---------------------------------------------------------------------------

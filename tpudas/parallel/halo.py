@@ -18,14 +18,17 @@ __all__ = ["exchange_halo_time"]
 
 
 def exchange_halo_time(block, halo: int, axis_name: str = "time",
-                       n_shards: int | None = None):
+                       n_shards: int | None = None,
+                       left: bool = True, right: bool = True):
     """Inside shard_map: return block extended with neighbor halos.
 
-    block: (T_local, ...) — the local time shard. Returns
-    ``(T_local + 2*halo, ...)``; call sites trim ``halo`` from each end
-    of the processed result to keep only valid interior.
+    block: (T_local, ...) — the local time shard. Returns the block
+    extended by ``halo`` rows on each requested side; call sites trim
+    the processed result to keep only valid interior. A one-sided
+    exchange (``left=False`` for a causal consumer that only looks
+    ahead) runs a single ppermute — half the ICI traffic.
     """
-    if halo <= 0:
+    if halo <= 0 or not (left or right):
         return block
     if halo > block.shape[0]:
         raise ValueError(
@@ -36,11 +39,19 @@ def exchange_halo_time(block, halo: int, axis_name: str = "time",
         n_shards = jax.lax.axis_size(axis_name)
     if n_shards == 1:
         pad = jnp.zeros((halo,) + block.shape[1:], block.dtype)
-        return jnp.concatenate([pad, block, pad], axis=0)
+        parts = [pad] if left else []
+        parts.append(block)
+        if right:
+            parts.append(pad)
+        return jnp.concatenate(parts, axis=0) if len(parts) > 1 else block
     fwd = [(i, i + 1) for i in range(n_shards - 1)]
     bwd = [(i + 1, i) for i in range(n_shards - 1)]
     # my tail -> right neighbor's left halo; my head -> left neighbor's
     # right halo. Unmatched shards (stream boundaries) receive zeros.
-    from_left = jax.lax.ppermute(block[-halo:], axis_name, fwd)
-    from_right = jax.lax.ppermute(block[:halo], axis_name, bwd)
-    return jnp.concatenate([from_left, block, from_right], axis=0)
+    parts = []
+    if left:
+        parts.append(jax.lax.ppermute(block[-halo:], axis_name, fwd))
+    parts.append(block)
+    if right:
+        parts.append(jax.lax.ppermute(block[:halo], axis_name, bwd))
+    return jnp.concatenate(parts, axis=0)

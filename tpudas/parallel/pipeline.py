@@ -20,6 +20,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 from jax import shard_map
 
@@ -27,7 +28,11 @@ from tpudas.ops.fftlen import next_tpu_fft_len
 from tpudas.ops.filter import fft_lowpass_response
 from tpudas.parallel.halo import exchange_halo_time
 
-__all__ = ["sharded_lowpass_decimate"]
+__all__ = [
+    "sharded_lowpass_decimate",
+    "sharded_cascade_decimate",
+    "sharded_cascade_layout",
+]
 
 
 def _local_filter_decimate(padded, d_sec, corner, order, halo, t_local, ratio):
@@ -93,3 +98,135 @@ def sharded_lowpass_decimate(
         jnp.asarray(data, jnp.float32), NamedSharding(mesh, spec_2d)
     )
     return jax.jit(step)(arr)
+
+
+# ---------------------------------------------------------------------------
+# time + channel sharded cascade (the product engine's mesh fast path)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_sharded_cascade_fn(
+    plan, n_loc, halo, engine, mesh, time_axis, ch_axis
+):
+    """jit-compiled shard_map cascade: (nt*t_local, C) -> (nt*n_loc, C).
+
+    Each time-shard receives its neighbors' halo rows over the ICI ring
+    (``exchange_halo_time``), drops the unused left halo, and runs the
+    causal cascade on its local block — valid because the cascade is
+    shift-invariant under multiples of the composite ratio, and
+    ``t_local = n_loc * ratio``. Channels split over ``ch_axis`` with
+    no communication at all.
+    """
+    import jax
+
+    from tpudas.ops.fir import (
+        _apply_cascade_stages,
+        _blocked_taps,
+        _pallas_interpret,
+        _stage_counts,
+    )
+
+    nt = mesh.shape[time_axis]
+    blocked = _blocked_taps(plan)
+    counts = _stage_counts(plan, n_loc)
+    use_pallas = engine == "pallas"
+    interpret = _pallas_interpret() if use_pallas else False
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(time_axis, ch_axis),),
+        out_specs=P(time_axis, ch_axis),
+        check_vma=False,
+    )
+    def step(block):
+        # causal consumer: only the RIGHT (look-ahead) halo is needed,
+        # so the exchange is one-sided — half the ICI traffic
+        padded = exchange_halo_time(
+            block, halo, axis_name=time_axis, n_shards=nt, left=False
+        )
+        return _apply_cascade_stages(
+            padded, blocked, counts, use_pallas, interpret
+        )
+
+    return jax.jit(step)
+
+
+def sharded_cascade_layout(mesh, plan, phase, n_out, T,
+                           time_axis="time"):
+    """(n_loc, t_local, halo) of the time-sharded cascade layout for a
+    T-row input — or ``None`` when it does not fit (a shard's halo
+    would exceed its local block: too many time shards for this
+    window/filter combination). Shared by the executor below and by
+    callers that need to predict per-device shapes (e.g. LFProc's
+    engine observability, which must see the LOCAL output count the
+    Pallas threshold sees)."""
+    from tpudas.ops.fir import cascade_input_need
+
+    nt = mesh.shape[time_axis]
+    ratio = int(plan.ratio)
+    n_out = int(n_out)
+    if n_out < 1 or nt < 1:
+        return None
+    # rows of the pre-shifted stream (phase < delay adds left padding)
+    T_shift = int(T) - (int(phase) - plan.delay)
+    # the shard grid must cover ALL real input rows, not just
+    # n_out*ratio of them: the last shard has no right neighbor, so any
+    # data past the grid would be replaced by boundary zeros inside the
+    # tail outputs' filter support
+    n_loc = max(-(-n_out // nt), -(-T_shift // (ratio * nt)))
+    t_local = n_loc * ratio
+    halo = cascade_input_need(plan, n_loc) - t_local
+    if halo < 0 or halo > t_local:
+        return None
+    return n_loc, t_local, halo
+
+
+def sharded_cascade_decimate(
+    mesh, x, plan, phase, n_out, engine="auto",
+    time_axis="time", ch_axis="ch",
+):
+    """Mesh-parallel :func:`tpudas.ops.fir.cascade_decimate`: the time
+    axis is sharded over ``time_axis`` (one-sided halo exchange over
+    ICI neighbors, sized from the cascade's exact input need) and
+    channels over ``ch_axis`` (zero-comm).
+
+    Bit-equal to the single-device cascade for the same (plan, phase,
+    n_out): out-of-data rows are zero in both layouts and each output's
+    reduction reads the same rows in the same order. Returns ``None``
+    when the layout does not fit (see :func:`sharded_cascade_layout`);
+    the caller then falls back to channel-only sharding.
+    """
+    import jax.numpy as jnp
+
+    from tpudas.ops.fir import resolve_cascade_engine
+
+    nt = mesh.shape[time_axis]
+    nc = mesh.shape[ch_axis]
+    layout = sharded_cascade_layout(
+        mesh, plan, phase, int(n_out), int(np.shape(x)[0]), time_axis
+    )
+    if layout is None:
+        return None
+    n_loc, t_local, halo = layout
+    n_out = int(n_out)
+    engine = resolve_cascade_engine(engine)
+    x = jnp.asarray(x, jnp.float32)
+    C = int(x.shape[1])
+    shift = int(phase) - plan.delay
+    if shift >= 0:
+        x2 = x[shift:]
+    else:
+        x2 = jnp.pad(x, ((-shift, 0), (0, 0)))
+    T_target = nt * t_local
+    pad_t = T_target - int(x2.shape[0])
+    if pad_t > 0:
+        x2 = jnp.pad(x2, ((0, pad_t), (0, 0)))
+    pad_c = -C % nc
+    if pad_c:
+        x2 = jnp.pad(x2, ((0, 0), (0, pad_c)))
+    fn = _build_sharded_cascade_fn(
+        plan, n_loc, halo, engine, mesh, time_axis, ch_axis
+    )
+    out = fn(x2)
+    return out[:n_out, :C]

@@ -104,13 +104,15 @@ def run_lowpass_realtime(
     filter_order=None,
     data_gap_tolorance=None,
     counters=None,
+    mesh=None,
 ):
     """Poll ``source`` and keep the low-pass output current.
 
     ``engine`` / ``on_gap`` / ``filter_order`` / ``data_gap_tolorance``
     are forwarded to :class:`LFProc` (None keeps its defaults), so the
     streaming path can run the cascade engine and gap policies the batch
-    path has.  Pass a :class:`tpudas.utils.profiling.Counters` to
+    path has. ``mesh`` (a :class:`jax.sharding.Mesh`) runs each round's
+    windows device-sharded — see :attr:`LFProc.mesh`.  Pass a :class:`tpudas.utils.profiling.Counters` to
     accumulate throughput; each processing round also emits a
     ``realtime_round`` event with its own real-time factor.
 
@@ -149,7 +151,7 @@ def run_lowpass_realtime(
             print("No new data was detected. Real-time processing ended successfully.")
             break
         if n_now > 0:
-            lfp = LFProc(sub)
+            lfp = LFProc(sub, mesh=mesh)
             lfp.update_processing_parameter(
                 output_sample_interval=d_t,
                 process_patch_size=int(process_patch_size),
