@@ -22,20 +22,27 @@ backend init intermittently hangs or raises at interpreter start):
 Engines (BENCH_ENGINE):
   cascade  (default) multistage polyphase FIR, response-matched to the
            Butterworth-squared reference filter (tpudas.ops.fir);
-           BENCH_PALLAS=1 uses the Pallas strided-FIR kernel for the
-           big stages, 0 the XLA polyphase formulation
+           BENCH_PALLAS=1 (TPU default) runs the Pallas strided-FIR
+           kernel for the big stages, 0 the XLA polyphase formulation
   fft      the rfft -> response multiply -> irfft -> gather engine
            (tpudas.proc.lfproc), kept as the parity baseline
 
-Windows are generated on device each iteration (fresh PRNG key per
-window, so XLA cannot cache across iterations) and results are reduced
-on device with one final host fetch forcing the full execution chain.
-Host->device ingest is EXCLUDED by default: this dev environment
-reaches the TPU through a tunnel whose measured H2D bandwidth is
-~30 MB/s — an artifact three orders of magnitude below the PCIe/NVMe
-ingest of a real edge deployment — and including it benchmarks the
-tunnel, not the framework. Set BENCH_INCLUDE_H2D=1 to measure the
-tunnel-fed path anyway.
+Measurement methodology (revised for BENCH_r04): the timed loop runs
+ENTIRELY on device as one dispatch — a lax.scan over several distinct
+resident windows, repeated to cover BENCH_ITERS — because on the axon
+tunnel a dispatch costs ~10 ms and a host sync ~66 ms, so any
+per-window host loop measures the tunnel, not the chip (that was
+BENCH_r03's 2.79 G ch-samp/s). Distinct windows per scan step keep XLA
+from hoisting the loop-invariant kernel (which otherwise yields
+"bandwidths" above HBM peak); RNG runs before the timer; window length
+is sized to the cascade's exact chain need so no stage pads (an
+internal pad materializes a full input copy — one extra HBM round-trip
+at the full-rate stage). Host->device ingest is EXCLUDED by default:
+this dev environment reaches the TPU through a tunnel whose measured
+H2D bandwidth is ~30 MB/s — an artifact three orders of magnitude
+below the PCIe/NVMe ingest of a real edge deployment — and including
+it benchmarks the tunnel, not the framework. Set BENCH_INCLUDE_H2D=1
+to measure the tunnel-fed path anyway.
 
 Prints ONE JSON line:
   metric           channel_samples_per_sec
@@ -50,9 +57,16 @@ Prints ONE JSON line:
                    resulting fraction of one chip's peak (fp32-on-MXU
                    peak per PALLAS_AXON_TPU_GEN; an estimate, not a
                    profiler readout)
-  engines          present when BENCH_COMPARE=1 and budget allows:
-                   measured ch-samp/s for cascade-xla / cascade-pallas /
-                   fft so the 'auto' default is chosen from data
+  hbm_gbps / hbm_frac  analytic minimum HBM traffic per window divided
+                   by wall time, and its fraction of the chip's HBM
+                   peak — the honest roofline for this ~5 flop/byte
+                   kernel (MFU is the wrong lens)
+  stages           per-stage [engine, emitted] ground truth of the
+                   cascade layout that actually ran
+  engines          present when BENCH_COMPARE=1 (TPU default) and
+                   budget allows: measured ch-samp/s for cascade-xla /
+                   cascade-pallas / fft so the 'auto' default is chosen
+                   from data
 
 BENCH_MODE=e2e measures the WHOLE product path instead of the resident
 kernel: a native tdas spool is synthesized on local disk and
@@ -64,9 +78,14 @@ is then input channel-samples per wall-second of the full pipeline and
 box the ~30 MB/s tunnel dominates e2e; the mode exists for hardware
 with local storage semantics.
 
+A default (kernel-mode) run ALSO appends an ``e2e`` sub-object to the
+JSON line — a bounded second child running the full product path on a
+local tdas spool — so every round artifact records the pipeline
+real-time factor beside the resident-kernel number.
+
 Env knobs: BENCH_T, BENCH_C, BENCH_ITERS, BENCH_ENGINE,
 BENCH_PALLAS=0/1, BENCH_INCLUDE_H2D=0/1, BENCH_COMPARE=0/1,
-BENCH_MODE=kernel/e2e, BENCH_E2E_SEC, BENCH_E2E_FS,
+BENCH_MODE=kernel/e2e, BENCH_E2E_SEC, BENCH_E2E_FS, BENCH_E2E_TIMEOUT,
 BENCH_BUDGET (total parent wall budget, s), BENCH_PROBE_TIMEOUT,
 BENCH_CHILD_TIMEOUT.
 """
@@ -85,6 +104,10 @@ import numpy as np
 # the MXU natively multiplies bf16 at 2x this — fp32 inputs take the
 # passes path).  Used only for the analytic MFU estimate.
 _PEAK_FP32 = {"v4": 275e12 / 2, "v5e": 197e12 / 2, "v5p": 459e12 / 2}
+
+# HBM bandwidth peak per chip (public figures, bytes/sec) — the honest
+# roofline for this kernel (a decimating FIR is ~5 flops/byte)
+_PEAK_HBM = {"v4": 1228e9, "v5e": 819e9, "v5p": 2765e9}
 
 # wall seconds the engine shoot-out needs before it is attempted
 _COMPARE_MIN_LEFT = 240
@@ -167,6 +190,7 @@ def _parent() -> None:
     # Phase 2: the measurement child, under a watchdog, one retry.
     env = dict(os.environ, BENCH_CHILD="1")
     last_diag = ""
+    line = None
     for attempt in range(2):
         remaining = deadline - time.monotonic()
         if remaining < 60:
@@ -201,11 +225,65 @@ def _parent() -> None:
             None,
         )
         if proc.returncode == 0 and line:
-            print(line)
-            return
+            break
+        line = None
         last_diag = f"measurement rc={proc.returncode}: " + _tail(proc.stderr)
         print(f"[bench] {last_diag}", file=sys.stderr, flush=True)
-    _fail("measurement never completed: " + last_diag)
+    if line is None:
+        _fail("measurement never completed: " + last_diag)
+
+    # Phase 3: when the primary run was the resident-kernel mode, also
+    # record the FULL product path (index -> native assembly -> H2D ->
+    # kernel -> HDF5) so the round artifact carries an e2e real-time
+    # factor beside the kernel number (VERDICT r3 #5). Failure or a
+    # thin budget must not cost the headline line.
+    result = json.loads(line)
+    if os.environ.get("BENCH_MODE", "kernel") == "kernel":
+        remaining = deadline - time.monotonic()
+        requested = float(os.environ.get("BENCH_E2E_TIMEOUT", 240))
+        e2e_timeout = min(requested, remaining - 10)
+        if e2e_timeout < 90:
+            reason = (
+                f"budget: {remaining:.0f}s left"
+                if remaining - 10 < 90
+                else f"BENCH_E2E_TIMEOUT={requested:.0f}s is below the "
+                "90s minimum"
+            )
+            result["e2e"] = {"skipped": reason}
+        else:
+            e2e_env = dict(env, BENCH_MODE="e2e")
+            e2e_env.setdefault("BENCH_C", "256")
+            try:
+                proc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__)],
+                    env=e2e_env,
+                    capture_output=True,
+                    text=True,
+                    timeout=e2e_timeout,
+                )
+                if proc.stderr:
+                    print(proc.stderr, file=sys.stderr, end="", flush=True)
+                e2e_line = next(
+                    (
+                        ln
+                        for ln in proc.stdout.splitlines()
+                        if ln.startswith("{")
+                    ),
+                    None,
+                )
+                if proc.returncode == 0 and e2e_line:
+                    result["e2e"] = json.loads(e2e_line)
+                else:
+                    result["e2e"] = {
+                        "error": f"rc={proc.returncode}: "
+                        + _tail(proc.stderr, 300)
+                    }
+            except subprocess.TimeoutExpired as exc:
+                result["e2e"] = {
+                    "error": f"timed out after {e2e_timeout:.0f}s; "
+                    + _tail(exc.stderr, 300)
+                }
+    print(json.dumps(result))
 
 
 # ------------------------------------------------------------------ child
@@ -239,28 +317,66 @@ def _build_fft_step(T, C, fs, dt_out, order):
 
 def _build_cascade_step(T, C, fs, dt_out, order, use_pallas, mesh=None,
                         time_shards=1):
-    from tpudas.ops.fir import _build_cascade_fn, design_cascade
+    """(kernel, analytic flops/window, T_used, report).
+
+    ``T_used`` is the pad-free window length closest to T (never below
+    the filter's receptive-field floor): the input is sized to the
+    cascade's exact chain need (tpudas.ops.fir.chain_layout) so no
+    stage materializes a padded copy of its input — at the full-rate
+    stage that copy is a whole extra HBM round-trip and was the largest
+    single overhead in the r03-era measurement. ``report`` carries the
+    per-stage layout that ACTUALLY runs (per-shard under a mesh) plus
+    the shard multiplier for traffic/flops accounting.
+    """
+    from tpudas.ops.fir import _build_cascade_fn, chain_layout, design_cascade
 
     corner = 1.0 / dt_out / 2.0 * 0.9
     ratio = int(round(dt_out * fs))
     plan = design_cascade(fs, ratio, corner, order)
-    # steady-state window phase: the engine's halo is edge_buff_size
-    # output samples; emitted sample 0 sits ratio*buff inside the
-    # window. delay alignment is free (slice), included in the timing.
-    n_out = T // ratio
     engine = "pallas" if use_pallas else "xla"
+    nc = mesh.shape["ch"] if mesh is not None else 1
+    c_local = -(-C // nc)
+    # decisions inside the kernel trace on the LOCAL channel count
+    _, floor_rows = chain_layout(plan, 1, c_local, engine)
+    n_out = max(1, (T - floor_rows) // ratio + 1)
+    layout, rows = chain_layout(plan, n_out, c_local, engine)
+    while rows > T and n_out > 1:
+        n_out = max(1, n_out - max(1, (rows - T) // ratio))
+        layout, rows = chain_layout(plan, n_out, c_local, engine)
+    T_used = rows
+    if T_used > T * 1.05:
+        print(
+            f"[bench] BENCH_T={T} is below this filter's receptive-"
+            f"field floor; windows of {T_used} rows will be measured",
+            file=sys.stderr,
+            flush=True,
+        )
+    shards = 1
     if mesh is not None and time_shards > 1:
-        from tpudas.parallel.pipeline import sharded_cascade_decimate
+        from tpudas.parallel.pipeline import (
+            sharded_cascade_decimate,
+            sharded_cascade_layout,
+        )
+
+        T_used = T  # the sharded path sizes its own per-shard grid
+        sl = sharded_cascade_layout(
+            mesh, plan, plan.delay, n_out, T,
+            n_ch_local=c_local, engine=engine,
+        )
+        if sl is None:
+            raise ValueError(
+                f"time_shards={time_shards} does not fit this "
+                f"window/filter (T={T}); lower BENCH_TIME_SHARDS"
+            )
+        # what each device actually traces: n_loc outputs, local C
+        layout, _ = chain_layout(plan, sl[0], c_local, engine)
+        shards = time_shards
 
         def fn(data):
             out = sharded_cascade_decimate(
                 mesh, data, plan, plan.delay, n_out, engine=engine
             )
-            if out is None:
-                raise ValueError(
-                    f"time_shards={time_shards} does not fit this "
-                    f"window/filter (T={T}); lower BENCH_TIME_SHARDS"
-                )
+            assert out is not None  # layout checked above
             return out
     elif mesh is not None:
         from tpudas.ops.fir import cascade_decimate
@@ -274,17 +390,34 @@ def _build_cascade_step(T, C, fs, dt_out, order, use_pallas, mesh=None,
     else:
         fn = _build_cascade_fn(plan, n_out, engine)
 
-    # per stage: a polyphase FIR producing T/prod(R) samples from
-    # `taps` MACs each -> 2*taps flops per output sample per channel
-    flops, t_in = 0.0, T
-    for R, taps in plan.stages:
-        t_out = t_in // int(R)
-        flops += 2.0 * len(taps) * t_out * C
-        t_in = t_out
-    return (lambda data: fn(data)), flops
+    # per stage: a polyphase FIR emitting k outputs from `taps` MACs
+    # each -> 2*taps flops per output sample per channel; under a mesh
+    # each of `shards` time-shards runs the per-shard layout over the
+    # full channel width (c_local * nc ~= C)
+    flops = 0.0
+    for (R, taps), (_, k) in zip(plan.stages, layout):
+        flops += 2.0 * len(taps) * k * C * shards
+    report = {
+        "stages": [[e, k] for e, k in layout],
+        "stages_scope": "per_shard" if shards > 1 else "global",
+        "emitted_k_factor": shards,
+    }
+    return (lambda data: fn(data)), flops, T_used, report
 
 
 def _measure(kernel, T, C, iters, include_h2d):
+    """Wall time for ``iters`` windows through ``kernel``.
+
+    Resident-kernel mode runs the ENTIRE measured loop on device as one
+    dispatch: a scan over NW distinct resident windows, repeated until
+    ``iters`` is covered. This is deliberate — on the axon tunnel a
+    host->device dispatch costs tens of ms and a full host sync ~66 ms,
+    so any per-window host loop measures the tunnel, not the chip
+    (BENCH_r03's 2.79 G ch-samp/s was exactly that). Distinct windows
+    per inner step keep XLA from hoisting the kernel out of the loop
+    (with one window the whole body is loop-invariant and the measured
+    "bandwidth" exceeds HBM peak). RNG runs before the timer.
+    """
     import jax
     import jax.numpy as jnp
 
@@ -298,19 +431,40 @@ def _measure(kernel, T, C, iters, include_h2d):
             out = jax.device_get(kernel(jnp.asarray(host_window)))
         elapsed = time.perf_counter() - t0
         assert np.isfinite(out).all()
-    else:
-        gen = jax.jit(lambda key: jax.random.normal(key, (T, C), jnp.float32))
-        step = jax.jit(lambda key: jnp.sum(jnp.abs(kernel(gen(key)))))
-        root = jax.random.PRNGKey(0)
-        float(step(jax.random.fold_in(root, 10**6)))  # compile + settle
+        return elapsed, iters
+
+    # NW resident windows within ~9 GB of HBM; rep covers iters
+    nw = max(1, min(6, int(9e9 // (T * C * 4))))
+    rep = max(1, -(-iters // nw))
+    gen = jax.jit(
+        lambda key: jax.random.normal(key, (nw, T, C), jnp.float32)
+    )
+    stack = gen(jax.random.PRNGKey(0))
+    jax.block_until_ready(stack)
+
+    @jax.jit
+    def run(st):
+        def body(tot, w):
+            return tot + jnp.sum(jnp.abs(kernel(w))), None
+
+        def outer(tot, _):
+            t, _ = jax.lax.scan(body, tot, st)
+            return t, None
+
+        tot, _ = jax.lax.scan(
+            outer, jnp.zeros((), jnp.float32), None, length=rep
+        )
+        return tot
+
+    checksum = float(run(stack))  # compile + settle
+    assert np.isfinite(checksum)
+    elapsed = 1e30
+    for _ in range(2):
         t0 = time.perf_counter()
-        total = jnp.zeros((), jnp.float32)
-        for i in range(iters):
-            total = total + step(jax.random.fold_in(root, i))
-        checksum = float(total)  # forces the whole chain
-        elapsed = time.perf_counter() - t0
+        checksum = float(run(stack))
+        elapsed = min(elapsed, time.perf_counter() - t0)
         assert np.isfinite(checksum)
-    return elapsed
+    return elapsed, nw * rep
 
 
 def _e2e_child(backend: str) -> None:
@@ -397,18 +551,28 @@ def _child() -> None:
         _e2e_child(backend)
         return
 
-    T = int(os.environ.get("BENCH_T", 131072))  # ~131 s @ 1 kHz
-    C = int(os.environ.get("BENCH_C", 2048))
-    iters = int(os.environ.get("BENCH_ITERS", 16))
-    engine = os.environ.get("BENCH_ENGINE", "cascade")
-    use_pallas = os.environ.get("BENCH_PALLAS", "0") == "1"
-    include_h2d = os.environ.get("BENCH_INCLUDE_H2D", "0") == "1"
-    compare = os.environ.get("BENCH_COMPARE", "0") == "1"
-    remaining = float(os.environ.get("BENCH_REMAINING", 1e9))
-
     child_start = time.monotonic()
     backend = jax.default_backend()
+    on_tpu = backend not in ("cpu",)
     print(f"[bench] child backend={backend}", file=sys.stderr, flush=True)
+
+    T = int(os.environ.get("BENCH_T", 131072))  # ~131 s @ 1 kHz
+    C = int(os.environ.get("BENCH_C", 2048))
+    # scan-loop iterations: one final host sync (~66 ms on the tunnel)
+    # amortizes over all of them, so TPU defaults run enough windows
+    # to make that overhead a small fraction of the measurement
+    iters = int(os.environ.get("BENCH_ITERS", 256 if on_tpu else 16))
+    engine = os.environ.get("BENCH_ENGINE", "cascade")
+    # TPU defaults flip the fast path and the shoot-out ON (VERDICT r3
+    # #3: the recorded JSON must carry pallas + engine-compare numbers)
+    use_pallas = (
+        os.environ.get("BENCH_PALLAS", "1" if on_tpu else "0") == "1"
+    )
+    include_h2d = os.environ.get("BENCH_INCLUDE_H2D", "0") == "1"
+    compare = (
+        os.environ.get("BENCH_COMPARE", "1" if on_tpu else "0") == "1"
+    )
+    remaining = float(os.environ.get("BENCH_REMAINING", 1e9))
 
     fs, dt_out, order = 1000.0, 1.0, 4
     mesh = None
@@ -429,31 +593,50 @@ def _child() -> None:
             )
             mesh = None
             mesh_info = None  # never report a mesh that did not run
+    report = None
     if engine == "cascade":
-        kernel, flops_win = _build_cascade_step(
+        kernel, flops_win, T_used, report = _build_cascade_step(
             T, C, fs, dt_out, order, use_pallas, mesh, time_shards
         )
     else:
         kernel, flops_win = _build_fft_step(T, C, fs, dt_out, order)
+        T_used = T
 
-    elapsed = _measure(kernel, T, C, iters, include_h2d)
+    elapsed, iters_done = _measure(kernel, T_used, C, iters, include_h2d)
 
-    channel_samples = T * C * iters
+    channel_samples = T_used * C * iters_done
     value = channel_samples / elapsed
-    flops_per_sec = flops_win * iters / elapsed
-    peak = _PEAK_FP32.get(os.environ.get("PALLAS_AXON_TPU_GEN", "v5e"))
+    flops_per_sec = flops_win * iters_done / elapsed
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    peak = _PEAK_FP32.get(gen)
     result = {
         "metric": "channel_samples_per_sec",
         "value": round(value, 1),
         "unit": "channel_samples/sec",
         "vs_baseline": round(value / 1e8, 4),
-        "realtime_factor": round(T * iters / fs / elapsed, 2),
+        "realtime_factor": round(T_used * iters_done / fs / elapsed, 2),
         "backend": backend,
         "engine": engine + ("-pallas" if use_pallas else ""),
-        "shape": [T, C],
+        "shape": [T_used, C],
+        "iters": iters_done,
         "flops_est": round(flops_per_sec / 1e12, 3),
         "flops_unit": "TFLOP/s",
     }
+    if report is not None:
+        # ground truth of what ran, plus the achieved fraction of the
+        # bandwidth roofline (this kernel is HBM-bound by design: ~5
+        # flops/byte; MFU is the wrong lens — VERDICT r3 #4)
+        result["stages"] = report["stages"]
+        if report["stages_scope"] != "global":
+            result["stages_scope"] = report["stages_scope"]
+        emitted = sum(k for _, k in report["stages"])
+        emitted *= report["emitted_k_factor"]
+        bytes_win = 4.0 * C * (T_used + 2.0 * emitted)
+        hbm = bytes_win * iters_done / elapsed
+        result["hbm_gbps"] = round(hbm / 1e9, 1)
+        peak_hbm = _PEAK_HBM.get(gen)
+        if peak_hbm and backend != "cpu":
+            result["hbm_frac"] = round(hbm / peak_hbm, 4)
     if mesh_info is not None:
         result["mesh"] = mesh_info
     if peak and backend != "cpu":
@@ -483,10 +666,10 @@ def _child() -> None:
         engines = {primary: round(value, 1)}  # already measured above
         for name, builder in (
             ("cascade-xla", lambda: _build_cascade_step(
-                T, C, fs, dt_out, order, False)),
+                T, C, fs, dt_out, order, False)[:3]),
             ("cascade-pallas", lambda: _build_cascade_step(
-                T, C, fs, dt_out, order, True)),
-            ("fft", lambda: _build_fft_step(T, C, fs, dt_out, order)),
+                T, C, fs, dt_out, order, True)[:3]),
+            ("fft", lambda: _build_fft_step(T, C, fs, dt_out, order) + (T,)),
         ):
             if name == primary:
                 continue
@@ -494,9 +677,9 @@ def _child() -> None:
                 engines[name] = "skipped: budget"
                 continue
             try:
-                k, _ = builder()
-                dt = _measure(k, T, C, cmp_iters, False)
-                engines[name] = round(T * C * cmp_iters / dt, 1)
+                k, _, t_used = builder()
+                dt, n_done = _measure(k, t_used, C, cmp_iters, False)
+                engines[name] = round(t_used * C * n_done / dt, 1)
             except Exception as exc:  # pallas may be unsupported on cpu
                 engines[name] = f"error: {exc}"[:120]
             print(

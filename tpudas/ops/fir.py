@@ -301,10 +301,10 @@ def _stage_counts(plan: CascadePlan, n_out: int) -> list[int]:
 
 
 def cascade_input_need(plan: CascadePlan, n_out: int) -> int:
-    """Input rows the cascade actually consumes to emit ``n_out``
-    outputs (after the delay pre-shift): the first stage's
-    ``(count + B) * R``. Shorter inputs are zero-padded by the
-    apply path; time-sharded callers size their halo from this."""
+    """Input rows the cascade minimally consumes to emit ``n_out``
+    outputs (after the delay pre-shift), with every stage on the XLA
+    path: the first stage's ``(count + B) * R``. Pallas-aware sizing
+    (grid rounding included) is :func:`chain_layout`'s ``rows``."""
     counts = _stage_counts(plan, int(n_out))
     R0, h0 = plan.stages[0]
     B0 = -(-len(h0) // int(R0))
@@ -312,11 +312,20 @@ def cascade_input_need(plan: CascadePlan, n_out: int) -> int:
 
 
 def _pallas_stage_ok(k: int, R: int, n_ch: int, n_frames: int) -> bool:
-    """Pallas only for stages that are both big enough to matter and
-    whose taps fit the kernel's 128-frame block; very long single-stage
-    plans (possible via the public design API) take the XLA polyphase
-    path instead of erroring."""
-    return k * R * n_ch >= (1 << 21) and n_frames <= 128
+    """Pallas only for stages that are big enough to matter: small
+    stages measure slower under the kernel (grid overheads dominate)
+    AND their 128-frame grid rounding inflates every upstream stage's
+    output count through the chain layout. Thresholds from the v5e
+    measurements behind BENCH_r04: >= 2^24 elements touched and a
+    reasonably full first grid step. Taps must also fit the kernel's
+    128-frame block; very long single-stage plans (possible via the
+    public design API) take the XLA polyphase path instead of
+    erroring."""
+    return (
+        k * R * n_ch >= (1 << 24)
+        and k >= 128
+        and n_frames <= 128
+    )
 
 
 def resolve_cascade_engine(engine: str = "auto") -> str:
@@ -328,6 +337,28 @@ def resolve_cascade_engine(engine: str = "auto") -> str:
     return engine
 
 
+def chain_layout(
+    plan: CascadePlan, n_out: int, n_ch: int, engine: str = "auto"
+):
+    """Per-stage execution layout: ``((engine_i, k_i), ...), rows``.
+
+    ``k_i`` is the output count stage ``i`` emits and ``engine_i`` the
+    kernel it runs under ('pallas'/'xla'); ``rows`` is the exact input
+    length the first stage consumes. Sized back to front so every
+    stage's input is exactly what its predecessor emits: an input of
+    exactly ``rows`` flows through the whole cascade with ZERO internal
+    padding (an internal ``jnp.pad`` materializes a full copy — a
+    whole extra HBM round-trip at the full-rate stage). Shorter inputs
+    still work (stages zero-pad, same numerics), they just pay the
+    copy. This is also the single source of truth for which engine
+    each stage actually runs (LFProc observability, the bench)."""
+    engine = resolve_cascade_engine(engine)
+    shapes = tuple(
+        (int(R), -(-len(h) // int(R))) for R, h in plan.stages
+    )
+    return _layout_for(shapes, int(n_out), int(n_ch), engine)
+
+
 def stage_engines(
     plan: CascadePlan, n_out: int, n_ch: int, engine: str = "auto"
 ) -> list[str]:
@@ -335,29 +366,50 @@ def stage_engines(
     decision :func:`_build_cascade_fn` makes at trace time, exposed so
     callers (LFProc observability, the bench) can report ground truth
     instead of the configured intent."""
-    engine = resolve_cascade_engine(engine)
-    out = []
-    for (R, h), k in zip(plan.stages, _stage_counts(plan, int(n_out))):
-        B = -(-len(h) // int(R))
-        use = engine == "pallas" and _pallas_stage_ok(k, int(R), int(n_ch), B)
-        out.append("pallas" if use else "xla")
-    return out
+    return [e for e, _ in chain_layout(plan, n_out, n_ch, engine)[0]]
 
 
-def _apply_cascade_stages(x, blocked, counts, use_pallas, interpret):
+def _apply_cascade_stages(x, blocked, n_out, use_pallas, interpret):
     """Traceable cascade body shared by the jit path and the shard_map
-    (mesh) paths: x (T_local, C_local) -> (counts[-1], C_local)."""
+    (mesh) paths: x (T_local, C_local) -> (n_out, C_local).
+
+    Per-stage engine/size decisions come from :func:`chain_layout` on
+    the traced shape, so emitted sizes line up stage to stage (pad-free
+    when the input is pre-sized to the layout's ``rows``)."""
     import jax.numpy as jnp
 
     x = x.astype(jnp.float32)
-    for (R, hb), k in zip(blocked, counts):
-        if use_pallas and _pallas_stage_ok(k, R, x.shape[1], hb.shape[0]):
+    engine = "pallas" if use_pallas else "xla"
+    layout, _rows = _layout_for(
+        tuple((int(R), int(hb.shape[0])) for R, hb in blocked),
+        int(n_out),
+        int(x.shape[1]),
+        engine,
+    )
+    for (R, hb), (eng, k) in zip(blocked, layout):
+        if eng == "pallas":
             from tpudas.ops.pallas_fir import fir_decimate_pallas
 
             x = fir_decimate_pallas(x, hb, R, n_out=k, interpret=interpret)
         else:
             x = _polyphase_stage_xla(x, hb, R, k)
     return x
+
+
+@functools.lru_cache(maxsize=256)
+def _layout_for(stage_shapes, n_out, n_ch, engine):
+    """chain_layout core on hashable (R, B) pairs: returns
+    ``(((engine_i, k_i), ...), rows)``."""
+    from tpudas.ops.pallas_fir import stage_input_rows
+
+    k = int(n_out)
+    ks: list = [None] * len(stage_shapes)
+    for i in range(len(stage_shapes) - 1, -1, -1):
+        R, B = stage_shapes[i]
+        use = engine == "pallas" and _pallas_stage_ok(k, R, n_ch, B)
+        ks[i] = ("pallas" if use else "xla", k)
+        k = stage_input_rows(B, R, k) if use else (k + B) * R
+    return tuple(ks), k
 
 
 def _blocked_taps(plan: CascadePlan):
@@ -391,12 +443,11 @@ def _build_cascade_fn(plan: CascadePlan, n_out: int, engine: str, mesh=None,
     import jax
 
     blocked = _blocked_taps(plan)
-    counts = _stage_counts(plan, n_out)
     use_pallas = engine == "pallas"
     interpret = _pallas_interpret() if use_pallas else False
 
     def fn(x):
-        return _apply_cascade_stages(x, blocked, counts, use_pallas, interpret)
+        return _apply_cascade_stages(x, blocked, n_out, use_pallas, interpret)
 
     if mesh is not None:
         from jax import shard_map

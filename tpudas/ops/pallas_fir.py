@@ -47,6 +47,25 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
+def _halo_frames(B: int, kb: int) -> int:
+    """Halo block frames: B rounded up to a sublane multiple that also
+    divides the main block (so the halo offset is an integer block
+    index). Single source for both the kernel and the sizing math."""
+    halo_f = _round_up(B, 8)
+    while halo_f <= kb and kb % halo_f != 0:
+        halo_f += 8
+    return halo_f
+
+
+def stage_input_rows(B: int, R: int, n_out: int, kb: int = _KB) -> int:
+    """Input rows this kernel consumes to emit ``n_out`` outputs with
+    B tap-frames at stride R — the grid/halo-padded figure. Feeding
+    exactly this many rows makes the kernel pad-free (the internal
+    ``jnp.pad`` otherwise materializes a full copy of the input, which
+    at engine scale is an extra HBM round-trip per stage)."""
+    return (_round_up(int(n_out), kb) + _halo_frames(B, kb)) * R
+
+
 def _kernel_body(B, KB, CB):
     def kernel(hb_ref, xm_ref, xh_ref, out_ref):
         full = jnp.concatenate([xm_ref[:], xh_ref[:]], axis=0)
@@ -77,9 +96,7 @@ def fir_decimate_pallas(
     B = int(hb.shape[0])
     T, C = x.shape
     KB, CB = int(kb), int(cb)
-    halo_f = _round_up(B, 8)
-    while halo_f <= KB and KB % halo_f != 0:
-        halo_f += 8
+    halo_f = _halo_frames(B, KB)
     if halo_f > KB:
         raise ValueError(
             f"tap frames ({B}) exceed the kernel block ({KB} frames); "
@@ -89,7 +106,7 @@ def fir_decimate_pallas(
     nk = -(-int(n_out) // KB)
     nc = -(-int(C) // CB)
     Kpad = nk * KB
-    need_rows = (Kpad + halo_f) * R
+    need_rows = stage_input_rows(B, R, n_out, KB)
     pad_t = need_rows - T
     pad_c = nc * CB - C
     if pad_t > 0 or pad_c > 0:

@@ -123,12 +123,10 @@ def _build_sharded_cascade_fn(
         _apply_cascade_stages,
         _blocked_taps,
         _pallas_interpret,
-        _stage_counts,
     )
 
     nt = mesh.shape[time_axis]
     blocked = _blocked_taps(plan)
-    counts = _stage_counts(plan, n_loc)
     use_pallas = engine == "pallas"
     interpret = _pallas_interpret() if use_pallas else False
 
@@ -146,22 +144,28 @@ def _build_sharded_cascade_fn(
             block, halo, axis_name=time_axis, n_shards=nt, left=False
         )
         return _apply_cascade_stages(
-            padded, blocked, counts, use_pallas, interpret
+            padded, blocked, n_loc, use_pallas, interpret
         )
 
     return jax.jit(step)
 
 
 def sharded_cascade_layout(mesh, plan, phase, n_out, T,
-                           time_axis="time"):
+                           time_axis="time", n_ch_local=1, engine="auto"):
     """(n_loc, t_local, halo) of the time-sharded cascade layout for a
     T-row input — or ``None`` when it does not fit (a shard's halo
     would exceed its local block: too many time shards for this
     window/filter combination). Shared by the executor below and by
     callers that need to predict per-device shapes (e.g. LFProc's
     engine observability, which must see the LOCAL output count the
-    Pallas threshold sees)."""
-    from tpudas.ops.fir import cascade_input_need
+    Pallas threshold sees).
+
+    ``n_ch_local``/``engine`` size the halo from the same chain layout
+    the shard body will trace (Pallas stages consume grid-rounded
+    inputs): a halo sized that way keeps every stage pad-free inside
+    the shard. The defaults give the plain ``(k+B)*R`` sizing.
+    """
+    from tpudas.ops.fir import chain_layout
 
     nt = mesh.shape[time_axis]
     ratio = int(plan.ratio)
@@ -176,7 +180,8 @@ def sharded_cascade_layout(mesh, plan, phase, n_out, T,
     # tail outputs' filter support
     n_loc = max(-(-n_out // nt), -(-T_shift // (ratio * nt)))
     t_local = n_loc * ratio
-    halo = cascade_input_need(plan, n_loc) - t_local
+    _, rows_local = chain_layout(plan, n_loc, int(n_ch_local), engine)
+    halo = rows_local - t_local
     if halo < 0 or halo > t_local:
         return None
     return n_loc, t_local, halo
@@ -203,8 +208,10 @@ def sharded_cascade_decimate(
 
     nt = mesh.shape[time_axis]
     nc = mesh.shape[ch_axis]
+    n_ch_local = -(-int(np.shape(x)[1]) // nc)
     layout = sharded_cascade_layout(
-        mesh, plan, phase, int(n_out), int(np.shape(x)[0]), time_axis
+        mesh, plan, phase, int(n_out), int(np.shape(x)[0]), time_axis,
+        n_ch_local=n_ch_local, engine=engine,
     )
     if layout is None:
         return None

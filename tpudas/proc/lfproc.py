@@ -546,7 +546,8 @@ class LFProc:
             from tpudas.parallel.pipeline import sharded_cascade_layout
 
             time_layout = sharded_cascade_layout(
-                mesh, plan, phase, n_out, int(host.shape[0])
+                mesh, plan, phase, n_out, int(host.shape[0]),
+                n_ch_local=-(-int(host.shape[1]) // mesh.shape["ch"]),
             )
         # observability: which engine actually ran this window (config
         # says "auto"/"cascade"; this count/event is the ground truth)
