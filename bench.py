@@ -144,6 +144,32 @@ def _probe_backend(timeout: float) -> tuple[bool, str]:
     return False, f"probe rc={proc.returncode}: " + _tail(proc.stderr, 500)
 
 
+def _run_child_process(env: dict, timeout: float):
+    """Run this script as a measurement child: returns
+    ``(json_line_or_None, diagnostic)`` with stderr passed through."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired as exc:
+        return None, (
+            f"timed out after {timeout:.0f}s; " + _tail(exc.stderr)
+        )
+    if proc.stderr:
+        print(proc.stderr, file=sys.stderr, end="", flush=True)
+    line = next(
+        (ln for ln in proc.stdout.splitlines() if ln.startswith("{")),
+        None,
+    )
+    if proc.returncode == 0 and line:
+        return line, ""
+    return None, f"rc={proc.returncode}: " + _tail(proc.stderr)
+
+
 def _fail(msg: str) -> None:
     print(
         json.dumps(
@@ -199,35 +225,10 @@ def _parent() -> None:
         # the child's compare gates must see the watchdog window, not
         # the (possibly larger) total budget, or compare overruns it
         env["BENCH_REMAINING"] = str(int(timeout))
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
-                env=env,
-                capture_output=True,
-                text=True,
-                timeout=timeout,
-            )
-        except subprocess.TimeoutExpired as exc:
-            last_diag = (
-                f"measurement timed out after {timeout:.0f}s; "
-                + _tail(exc.stderr)
-            )
-            print(f"[bench] {last_diag}", file=sys.stderr, flush=True)
-            continue
-        if proc.stderr:
-            print(proc.stderr, file=sys.stderr, end="", flush=True)
-        line = next(
-            (
-                ln
-                for ln in proc.stdout.splitlines()
-                if ln.startswith("{")
-            ),
-            None,
-        )
-        if proc.returncode == 0 and line:
+        line, diag = _run_child_process(env, timeout)
+        if line is not None:
             break
-        line = None
-        last_diag = f"measurement rc={proc.returncode}: " + _tail(proc.stderr)
+        last_diag = "measurement " + diag
         print(f"[bench] {last_diag}", file=sys.stderr, flush=True)
     if line is None:
         _fail("measurement never completed: " + last_diag)
@@ -253,36 +254,11 @@ def _parent() -> None:
         else:
             e2e_env = dict(env, BENCH_MODE="e2e")
             e2e_env.setdefault("BENCH_C", "256")
-            try:
-                proc = subprocess.run(
-                    [sys.executable, os.path.abspath(__file__)],
-                    env=e2e_env,
-                    capture_output=True,
-                    text=True,
-                    timeout=e2e_timeout,
-                )
-                if proc.stderr:
-                    print(proc.stderr, file=sys.stderr, end="", flush=True)
-                e2e_line = next(
-                    (
-                        ln
-                        for ln in proc.stdout.splitlines()
-                        if ln.startswith("{")
-                    ),
-                    None,
-                )
-                if proc.returncode == 0 and e2e_line:
-                    result["e2e"] = json.loads(e2e_line)
-                else:
-                    result["e2e"] = {
-                        "error": f"rc={proc.returncode}: "
-                        + _tail(proc.stderr, 300)
-                    }
-            except subprocess.TimeoutExpired as exc:
-                result["e2e"] = {
-                    "error": f"timed out after {e2e_timeout:.0f}s; "
-                    + _tail(exc.stderr, 300)
-                }
+            e2e_line, diag = _run_child_process(e2e_env, e2e_timeout)
+            if e2e_line is not None:
+                result["e2e"] = json.loads(e2e_line)
+            else:
+                result["e2e"] = {"error": diag[:400]}
     print(json.dumps(result))
 
 
@@ -431,10 +407,21 @@ def _measure(kernel, T, C, iters, include_h2d):
             out = jax.device_get(kernel(jnp.asarray(host_window)))
         elapsed = time.perf_counter() - t0
         assert np.isfinite(out).all()
-        return elapsed, iters
+        return elapsed, iters, None
 
     # NW resident windows within ~9 GB of HBM; rep covers iters
     nw = max(1, min(6, int(9e9 // (T * C * 4))))
+    if nw == 1:
+        # a single resident window makes the scan body loop-invariant —
+        # XLA may hoist it and the number inflates past HBM peak. Never
+        # silently: the caller reports windows_resident and this warns.
+        print(
+            "[bench] WARNING: window too large for >1 resident copy; "
+            "single-window loop is hoistable and the result may be "
+            "inflated — reduce BENCH_T/BENCH_C",
+            file=sys.stderr,
+            flush=True,
+        )
     rep = max(1, -(-iters // nw))
     gen = jax.jit(
         lambda key: jax.random.normal(key, (nw, T, C), jnp.float32)
@@ -464,7 +451,7 @@ def _measure(kernel, T, C, iters, include_h2d):
         checksum = float(run(stack))
         elapsed = min(elapsed, time.perf_counter() - t0)
         assert np.isfinite(checksum)
-    return elapsed, nw * rep
+    return elapsed, nw * rep, nw
 
 
 def _e2e_child(backend: str) -> None:
@@ -602,7 +589,9 @@ def _child() -> None:
         kernel, flops_win = _build_fft_step(T, C, fs, dt_out, order)
         T_used = T
 
-    elapsed, iters_done = _measure(kernel, T_used, C, iters, include_h2d)
+    elapsed, iters_done, n_resident = _measure(
+        kernel, T_used, C, iters, include_h2d
+    )
 
     channel_samples = T_used * C * iters_done
     value = channel_samples / elapsed
@@ -619,6 +608,7 @@ def _child() -> None:
         "engine": engine + ("-pallas" if use_pallas else ""),
         "shape": [T_used, C],
         "iters": iters_done,
+        "windows_resident": n_resident,
         "flops_est": round(flops_per_sec / 1e12, 3),
         "flops_unit": "TFLOP/s",
     }
@@ -637,6 +627,11 @@ def _child() -> None:
         peak_hbm = _PEAK_HBM.get(gen)
         if peak_hbm and backend != "cpu":
             result["hbm_frac"] = round(hbm / peak_hbm, 4)
+    if n_resident == 1:
+        result["warning"] = (
+            "single resident window: the scan body is loop-invariant "
+            "and XLA hoisting may inflate this number"
+        )
     if mesh_info is not None:
         result["mesh"] = mesh_info
     if peak and backend != "cpu":
@@ -678,7 +673,7 @@ def _child() -> None:
                 continue
             try:
                 k, _, t_used = builder()
-                dt, n_done = _measure(k, t_used, C, cmp_iters, False)
+                dt, n_done, _ = _measure(k, t_used, C, cmp_iters, False)
                 engines[name] = round(t_used * C * n_done / dt, 1)
             except Exception as exc:  # pallas may be unsupported on cpu
                 engines[name] = f"error: {exc}"[:120]

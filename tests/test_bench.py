@@ -109,6 +109,9 @@ class TestParentFlow:
         assert result["value"] > 0
         assert result["stages"]  # layout ground truth present
         e2e = result["e2e"]
+        assert "skipped" not in e2e and "error" not in e2e, (
+            f"e2e child did not run: {e2e}"
+        )
         assert e2e["mode"] == "e2e"
         assert e2e["realtime_factor"] > 0
         assert e2e["native_windows"] >= 1

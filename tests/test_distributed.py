@@ -72,3 +72,52 @@ class TestQueries:
     def test_single_process(self):
         assert dist.is_distributed() is False
         assert len(dist.global_mesh_devices()) == len(jax.devices())
+
+
+class TestRealTwoProcessDCN:
+    def test_two_process_mesh_collectives(self):
+        """The real thing, no mocks: two spawned processes call
+        jax.distributed.initialize (via initialize_multihost env
+        config), build one global (2, 4) mesh whose time axis spans the
+        process boundary, and run psum + ppermute-halo collectives
+        across it (BASELINE config 5's DCN direction, VERDICT r3 #7)."""
+        import os
+        import socket
+        import subprocess
+        import sys
+
+        import __graft_entry__ as g
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        worker = os.path.join(os.path.dirname(__file__), "dcn_worker.py")
+        procs = []
+        for pid in range(2):
+            env = g._clean_cpu_env(4)  # 4 virtual devices per process
+            env.update(
+                COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+                NUM_PROCESSES="2",
+                PROCESS_ID=str(pid),
+            )
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, worker],
+                    env=env,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                    text=True,
+                )
+            )
+        outs = []
+        for p in procs:
+            try:
+                out, err = p.communicate(timeout=180)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                pytest.fail("DCN worker timed out (coordinator hang?)")
+            outs.append((p.returncode, out, err))
+        for rc, out, err in outs:
+            assert rc == 0, err[-1500:]
+            assert "DCN_WORKER_OK" in out, (out, err[-500:])

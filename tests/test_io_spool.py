@@ -221,3 +221,23 @@ class TestMemorySpoolAndMerge:
         segs = MemorySpool([p]).chunk(time=10.0)
         assert len(segs) == 3
         assert all(s.shape[0] == 1000 for s in segs)
+
+
+class TestContentsColumns:
+    def test_memory_spool_identity_columns(self):
+        p = synthetic_patch(duration=5, fs=100.0, n_ch=4)
+        q = p.update_attrs(network="XX", station="WELL1", tag="raw")
+        df = MemorySpool([q]).get_contents()
+        for col in ("network", "station", "tag", "instrument_id",
+                    "data_units", "dims", "time_min", "time_step"):
+            assert col in df.columns, col
+        assert df.loc[0, "network"] == "XX"
+        assert df.loc[0, "station"] == "WELL1"
+        assert df.loc[0, "dims"] == "time,distance"
+        assert df.loc[0, "instrument_id"] == ""  # absent -> empty string
+
+    def test_directory_spool_identity_columns(self, spool_dir):
+        df = spool(spool_dir).update().get_contents()
+        for col in ("network", "station", "tag", "instrument_id",
+                    "data_units", "dims", "path", "format"):
+            assert col in df.columns, col

@@ -193,21 +193,46 @@ class BaseSpool:
                 )
         return MemorySpool(out)
 
+    # the DASCore-style identity columns every contents frame carries
+    # (in addition to the coordinate-range columns); absent metadata is
+    # an empty string, as in DASCore's frame
+    _ID_COLUMNS = (
+        "network",
+        "station",
+        "tag",
+        "instrument_id",
+        "data_units",
+        "dims",
+    )
+
     def get_contents(self) -> pd.DataFrame:
+        """Summary DataFrame of the spool, one row per patch
+        (``Spool.get_contents()`` — low_pass_dascore.ipynb:81).
+
+        Columns: coordinate ranges/steps/counts plus the DASCore
+        identity columns (network/station/tag/instrument_id/
+        data_units/dims). A subset of DASCore's full contents frame —
+        columns DASCore derives from formats tpudas does not read
+        (e.g. cable_id) are omitted rather than emitted empty.
+        """
         rows = []
         for p in self._materialize():
             a = p.attrs
-            rows.append(
-                {
-                    "time_min": a.get("time_min"),
-                    "time_max": a.get("time_max"),
-                    "time_step": a.get("time_step"),
-                    "distance_min": a.get("distance_min"),
-                    "distance_max": a.get("distance_max"),
-                    "ntime": len(p.coords.get("time", ())),
-                    "ndistance": len(p.coords.get("distance", ())),
-                }
-            )
+            row = {
+                "time_min": a.get("time_min"),
+                "time_max": a.get("time_max"),
+                "time_step": a.get("time_step"),
+                "distance_min": a.get("distance_min"),
+                "distance_max": a.get("distance_max"),
+                "ntime": len(p.coords.get("time", ())),
+                "ndistance": len(p.coords.get("distance", ())),
+            }
+            for col in self._ID_COLUMNS:
+                if col == "dims":
+                    row[col] = ",".join(p.dims)
+                else:
+                    row[col] = a.get(col) or ""
+            rows.append(row)
         return pd.DataFrame(rows)
 
 
@@ -341,7 +366,14 @@ class DirectorySpool(BaseSpool):
         return [self._read_row(row) for _, row in df.iloc[item].iterrows()]
 
     def get_contents(self) -> pd.DataFrame:
-        return self._frame()
+        """Index-backed contents frame (no file payload IO); carries
+        the same identity columns as the in-memory frame — empty when
+        the format's scan record does not include them."""
+        df = self._frame().copy()
+        for col in self._ID_COLUMNS:
+            if col not in df.columns:
+                df[col] = ""
+        return df
 
     def native_window_plan(self, t_lo, t_hi):
         """An :func:`tpudas.io.tdas.plan_window_from_records` plan for

@@ -171,22 +171,38 @@ class PatchRoller:
         return self._apply("max")
 
     def std(self):
-        """Population std via E[x^2] - E[x]^2 on the same windows."""
+        """Population std on the same windows.
+
+        Computed on offset-shifted data ``y = x - mean(x)`` before the
+        ``E[y^2] - E[y]^2`` identity: with a large DC offset (common in
+        raw strain-rate counts) the unshifted identity cancels
+        catastrophically in f32 — the two terms agree to ~offset^2 and
+        the variance drowns in rounding. Shifting makes the residual
+        means window-scale, so the subtraction is well conditioned.
+        """
         p = self.patch
         ax = p.axis_of(self.dim)
-        m = rolling_reduce(
-            p.data, self.window, self.step, "mean", axis=ax, engine=self.engine
+        host = self.engine in ("numpy", "host")
+        xp = np if host else jnp
+        data = (
+            np.asarray(p.data, np.float64) if host else jnp.asarray(p.data)
         )
-        data = p.data
-        sq = (
-            np.asarray(data, np.float64) ** 2
-            if self.engine in ("numpy", "host")
-            else jnp.asarray(data) ** 2
+        if not host and not jnp.issubdtype(data.dtype, jnp.floating):
+            data = data.astype(jnp.float32)
+        # nanmean + nan_to_num: a single NaN gap sample must only NaN
+        # the windows that overlap it (as mean/sum do), not poison the
+        # whole channel through the shift
+        shift = xp.nan_to_num(
+            xp.nanmean(data, axis=ax, keepdims=True), nan=0.0
+        )
+        y = data - shift
+        m = rolling_reduce(
+            y, self.window, self.step, "mean", axis=ax, engine=self.engine
         )
         m2 = rolling_reduce(
-            sq, self.window, self.step, "mean", axis=ax, engine=self.engine
+            y * y, self.window, self.step, "mean", axis=ax,
+            engine=self.engine,
         )
-        xp = np if self.engine in ("numpy", "host") else jnp
         var = xp.maximum(m2 - m**2, 0)
         out = xp.sqrt(var)
         coords, attrs = self._stepped_coords_attrs(p)
