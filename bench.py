@@ -258,7 +258,8 @@ def _parent() -> None:
             if e2e_line is not None:
                 result["e2e"] = json.loads(e2e_line)
             else:
-                result["e2e"] = {"error": diag[:400]}
+                # keep the TAIL — the crash line lives at the end
+                result["e2e"] = {"error": diag[-400:]}
     print(json.dumps(result))
 
 
@@ -468,6 +469,12 @@ def _e2e_child(backend: str) -> None:
     sec = int(os.environ.get("BENCH_E2E_SEC", 120))
     fs = float(os.environ.get("BENCH_E2E_FS", 1000.0))
     engine = os.environ.get("BENCH_ENGINE", "auto")
+    # int16: quantized spool -> raw native assembly -> device decode
+    # (half the H2D bytes; the realistic edge-interrogator payload)
+    dtype = os.environ.get("BENCH_E2E_DTYPE", "float32")
+    write_kwargs = (
+        {"dtype": "int16", "scale": 1e-3} if dtype == "int16" else None
+    )
     file_sec = 30.0
     # the timed range must equal the synthesized data span exactly, or
     # the reported rate would credit samples never read
@@ -487,7 +494,7 @@ def _e2e_child(backend: str) -> None:
         make_synthetic_spool(
             src, n_files=n_files, file_duration=file_sec,
             fs=fs, n_ch=C, noise=0.01, lf_freq=0.05, hf_freq=40.0,
-            format="tdas",
+            format="tdas", write_kwargs=write_kwargs,
         )
         lfp = LFProc(make_spool(src).sort("time").update())
         lfp.update_processing_parameter(
@@ -516,6 +523,7 @@ def _e2e_child(backend: str) -> None:
                 "backend": backend,
                 "engine": engine,
                 "mode": "e2e",
+                "payload": dtype,
                 "shape": [int(sec * fs), C],
                 "native_windows": lfp.native_windows,
                 "engine_counts": lfp.engine_counts,

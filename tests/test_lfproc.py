@@ -337,6 +337,31 @@ class TestEndToEnd:
         with pytest.raises(ValueError, match="on_gap"):
             lfp.update_processing_parameter(on_gap="bogus")
 
+    def test_window_timing_breakdown(self, spool_dir, tmp_path):
+        # SURVEY §5 tracing row: per-phase wall breakdown on the
+        # instance (assemble wait / device / HDF5 write)
+        lfp = run_lfproc(
+            spool_dir, tmp_path / "t", "2023-03-22T00:00:00",
+            "2023-03-22T00:02:00",
+        )
+        t = lfp.timings
+        assert set(t) == {"assemble_s", "device_s", "write_s"}
+        assert t["device_s"] > 0 and t["write_s"] > 0
+        assert all(v >= 0 for v in t.values())
+
+    def test_trace_dir_writes_profile(self, spool_dir, tmp_path,
+                                      monkeypatch):
+        # TPUDAS_TRACE_DIR captures a jax.profiler device trace of the
+        # whole run
+        trace = tmp_path / "trace"
+        monkeypatch.setenv("TPUDAS_TRACE_DIR", str(trace))
+        run_lfproc(
+            spool_dir, tmp_path / "out", "2023-03-22T00:00:00",
+            "2023-03-22T00:01:00",
+        )
+        files = [f for _, _, fs in os.walk(trace) for f in fs]
+        assert files, "no profiler trace written"
+
     def test_split_no_coverage_warns_loudly(self, spool_dir, tmp_path,
                                             capsys):
         # a split run whose range holds no data at all must say so —

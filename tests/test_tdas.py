@@ -197,6 +197,33 @@ class TestSpoolIntegration:
         )
         assert plan is not None  # fast path alive across the upgrade
 
+    def test_v2_cache_discarded_so_int16_fast_path_fires(self, tmp_path):
+        """A v2 cache (pre dtype_code/scale) must be discarded whole,
+        or an int16 spool indexed before the upgrade would never plan
+        the raw device-decode path (round-4 review)."""
+        import json
+
+        from tpudas.io.index import INDEX_FILENAME, DirectoryIndex
+
+        make_synthetic_spool(
+            tmp_path, n_files=2, file_duration=10.0, fs=100.0, n_ch=4,
+            format="tdas", write_kwargs={"dtype": "int16", "scale": 1e-3},
+        )
+        DirectoryIndex(str(tmp_path)).update()
+        cache = tmp_path / INDEX_FILENAME
+        raw = json.loads(cache.read_text())
+        raw["version"] = 2
+        for rec in raw["files"].values():
+            rec.pop("dtype_code", None)
+            rec.pop("scale", None)
+        cache.write_text(json.dumps(raw))
+        sp = spool(str(tmp_path)).sort("time").update()
+        plan = sp.native_window_plan(
+            np.datetime64("2023-03-22T00:00:02"),
+            np.datetime64("2023-03-22T00:00:18"),
+        )
+        assert plan is not None and plan["payload"] == "int16"
+
     def test_truncated_indexed_file_record_dropped(self, tmp_path):
         """A file that was indexed complete and later truncated in
         place must lose its (now stale) index record — not serve a
@@ -362,6 +389,116 @@ class TestWindowPlan:
             np.datetime64("2023-03-22T00:01:05"),
         )
         assert plan is None  # gap -> generic path decides on_gap policy
+
+    def test_int16_plan_assembles_raw_with_scale(self, tmp_path):
+        """Uniform-int16 spools plan a raw (device-decode) assembly:
+        int16 payload + data_scale attr, byte-identical to the decoded
+        read path after host-side dequantization."""
+        make_synthetic_spool(
+            tmp_path, n_files=3, file_duration=10.0, fs=100.0, n_ch=8,
+            noise=0.05, format="tdas",
+            write_kwargs={"dtype": "int16", "scale": 1e-3},
+        )
+        sp = spool(str(tmp_path)).sort("time").update()
+        t_lo = np.datetime64("2023-03-22T00:00:04")
+        t_hi = np.datetime64("2023-03-22T00:00:27.5")
+        plan = sp.native_window_plan(t_lo, t_hi)
+        assert plan is not None
+        assert plan["payload"] == "int16"
+        assert plan["scale"] == pytest.approx(1e-3)
+        qpatch = tdas.assemble_window_patch(plan)
+        assert qpatch.host_data().dtype == np.int16
+        assert qpatch.attrs["data_scale"] == pytest.approx(1e-3)
+        decoded = qpatch.host_data().astype(np.float32) * np.float32(
+            plan["scale"]
+        )
+        merged = spool(sp.select(time=(t_lo, t_hi))).chunk(time=None)[0]
+        assert np.array_equal(decoded, merged.host_data())
+
+    def test_int16_raw_numpy_fallback_identical(self, tmp_path,
+                                                monkeypatch):
+        make_synthetic_spool(
+            tmp_path, n_files=2, file_duration=10.0, fs=100.0, n_ch=8,
+            noise=0.05, format="tdas",
+            write_kwargs={"dtype": "int16", "scale": 2e-3},
+        )
+        sp = spool(str(tmp_path)).sort("time").update().select(
+            distance=(10.0, 30.0)
+        )
+        plan = sp.native_window_plan(
+            np.datetime64("2023-03-22T00:00:02"),
+            np.datetime64("2023-03-22T00:00:18"),
+        )
+        assert plan is not None and plan["payload"] == "int16"
+        native = tdas.assemble_window_raw(
+            plan["segments"], plan["c_lo"], plan["c_hi"],
+            plan["total_rows"], dtype_code=1,
+        )
+        monkeypatch.setattr(tdas, "load_streamio", lambda: None)
+        fallback = tdas.assemble_window_raw(
+            plan["segments"], plan["c_lo"], plan["c_hi"],
+            plan["total_rows"], dtype_code=1,
+        )
+        assert native.dtype == np.int16
+        assert np.array_equal(native, fallback)
+
+    def test_mixed_scale_int16_falls_back_to_float32(self, tmp_path):
+        # default int16 writing picks a per-file peak scale -> scales
+        # differ -> the raw path must NOT fire (a single scale cannot
+        # decode the window); decoded-f32 assembly still applies
+        make_synthetic_spool(
+            tmp_path, n_files=3, file_duration=10.0, fs=100.0, n_ch=4,
+            noise=0.05, format="tdas", write_kwargs={"dtype": "int16"},
+        )
+        sp = spool(str(tmp_path)).sort("time").update()
+        plan = sp.native_window_plan(
+            np.datetime64("2023-03-22T00:00:02"),
+            np.datetime64("2023-03-22T00:00:28"),
+        )
+        assert plan is not None
+        assert plan["payload"] == "float32"
+        patch = tdas.assemble_window_patch(plan)
+        assert patch.host_data().dtype == np.float32
+
+    def test_lfproc_device_decode_matches_host_decode(self, tmp_path):
+        """The engine on a uniform-int16 spool (device decode) produces
+        byte-identical output to the same engine fed host-decoded f32
+        patches of the same quantized data."""
+        from tpudas.io.spool import MemorySpool
+        from tpudas.proc.lfproc import LFProc
+
+        src = tmp_path / "q"
+        make_synthetic_spool(
+            src, n_files=4, file_duration=30.0, fs=100.0, n_ch=6,
+            noise=0.01, format="tdas",
+            write_kwargs={"dtype": "int16", "scale": 1e-3},
+        )
+        t0 = np.datetime64("2023-03-22T00:00:00")
+        t1 = np.datetime64("2023-03-22T00:02:00")
+        results = {}
+        for label, sp in (
+            ("device", spool(str(src)).sort("time").update()),
+            (
+                "host",
+                MemorySpool(
+                    list(spool(str(src)).sort("time").update())
+                ),  # read path host-decodes to f32
+            ),
+        ):
+            lfp = LFProc(sp)
+            lfp.update_processing_parameter(
+                output_sample_interval=1.0, process_patch_size=50,
+                edge_buff_size=10,
+            )
+            out = tmp_path / f"out_{label}"
+            lfp.set_output_folder(str(out), delete_existing=True)
+            lfp.process_time_range(t0, t1)
+            if label == "device":
+                assert lfp.native_windows > 0  # raw fast path fired
+            results[label] = (
+                spool(str(out)).update().chunk(time=None)[0].host_data()
+            )
+        assert np.array_equal(results["device"], results["host"])
 
     def test_plan_none_for_dasdae(self, tmp_path):
         make_synthetic_spool(

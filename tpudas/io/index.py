@@ -85,12 +85,13 @@ class DirectoryIndex:
         return os.path.join(self.directory, INDEX_FILENAME)
 
     # bump when scan records gain fields the planner depends on (v2:
-    # exact tdas "dx"); a cache of any other version is discarded whole
-    # so every file is rescanned — header-only reads, cheap — instead
-    # of old and new records coexisting (a mixed set would fail the
-    # planner's geometry-equality check and silently disable the
-    # native fast path forever)
-    CACHE_VERSION = 2
+    # exact tdas "dx"; v3: "dtype_code"/"scale" for the int16 raw
+    # path); a cache of any other version is discarded whole so every
+    # file is rescanned — header-only reads, cheap — instead of old and
+    # new records coexisting (a mixed set would fail the planner's
+    # geometry-equality check and silently disable the native fast
+    # path forever)
+    CACHE_VERSION = 3
 
     def _load_cache(self):
         self._loaded_cache = True

@@ -184,7 +184,8 @@ def _ch_range(hdr, distance):
     return lo, max(lo, hi)
 
 
-def _read_block_numpy(path, hdr, t_lo, t_hi, c_lo, c_hi):
+def _read_rows_raw_numpy(path, hdr, t_lo, t_hi, c_lo, c_hi):
+    """Raw payload rows (no numeric conversion), channel-sliced."""
     dt = _DTYPES[hdr["dtype_code"]]
     es = dt().itemsize
     n_ch = hdr["n_ch"]
@@ -192,7 +193,11 @@ def _read_block_numpy(path, hdr, t_lo, t_hi, c_lo, c_hi):
     with open(path, "rb") as fh:
         fh.seek(_HEADER_SIZE + t_lo * n_ch * es)
         raw = np.fromfile(fh, dtype=dt, count=rows * n_ch)
-    raw = raw.reshape(rows, n_ch)[:, c_lo:c_hi]
+    return raw.reshape(rows, n_ch)[:, c_lo:c_hi]
+
+
+def _read_block_numpy(path, hdr, t_lo, t_hi, c_lo, c_hi):
+    raw = _read_rows_raw_numpy(path, hdr, t_lo, t_hi, c_lo, c_hi)
     if hdr["dtype_code"] == 1:
         return raw.astype(np.float32) * np.float32(hdr["scale"])
     return np.ascontiguousarray(raw, np.float32)
@@ -291,6 +296,11 @@ def scan_tdas(path):
             "ntime": int(hdr["n_time"]),
             "ndistance": int(hdr["n_ch"]),
             "dx": float(hdr["dx"]),
+            # payload dtype + quantization scale: lets the window
+            # planner route uniform-int16 spools through the raw
+            # assembler (device-side decode, half the H2D bytes)
+            "dtype_code": int(hdr["dtype_code"]),
+            "scale": float(hdr["scale"]),
         }
     ]
 
@@ -348,6 +358,21 @@ def plan_window_from_records(records, t_lo, t_hi, distance=None):
             or _exact_dx(r) != dx
         ):
             return None
+    # uniform int16 payload (same quantization scale everywhere, known
+    # for every record) -> the raw fast path: assemble int16, decode on
+    # device. Anything else (f32, mixed, or pre-dtype index records)
+    # assembles decoded float32 as before.
+    codes = {r.get("dtype_code") for r in recs}
+    scales = {r.get("scale") for r in recs}
+    if codes == {1} and len(scales) == 1:
+        (scale,) = scales
+        payload = (
+            ("int16", float(scale))
+            if scale is not None and np.isfinite(scale)
+            else ("float32", None)
+        )
+    else:
+        payload = ("float32", None)
     c_lo, c_hi = _ch_range(
         {"n_ch": nd, "d0": d0, "dx": dx}, distance
     )
@@ -383,14 +408,31 @@ def plan_window_from_records(records, t_lo, t_hi, distance=None):
         "dt_ns": int(dt_ns),
         "d0": d0,
         "dx": dx,
+        "payload": payload[0],
+        "scale": payload[1],
     }
 
 
 def assemble_window_patch(plan, n_threads=None) -> Patch:
     """Execute a :func:`plan_window_from_records` plan: one native
-    threaded multi-file read into a single pinned float32 buffer,
-    wrapped as a Patch (the overlap-save hot-loop ingest,
-    SURVEY.md §3.1 hot loops #2/#3)."""
+    threaded multi-file read into a single contiguous buffer, wrapped
+    as a Patch (the overlap-save hot-loop ingest, SURVEY.md §3.1 hot
+    loops #2/#3).
+
+    An ``int16`` plan assembles the RAW quantized payload and returns
+    an int16 Patch carrying its quantization scale as the
+    ``data_scale`` attr — the engine transfers half the bytes to the
+    device and runs the (cast * scale) decode there. Such quantized
+    patches exist only inside the engine's window path; the public
+    read API (:func:`read_tdas`) always decodes to float32.
+    """
+    if plan.get("payload") == "int16":
+        data = assemble_window_raw(
+            plan["segments"], plan["c_lo"], plan["c_hi"],
+            plan["total_rows"], dtype_code=1, n_threads=n_threads,
+        )
+        patch = _patch_from_block(plan, data, 0, plan["c_lo"])
+        return patch.update_attrs(data_scale=float(plan["scale"]))
     data = assemble_window(
         plan["segments"], plan["c_lo"], plan["c_hi"], plan["total_rows"],
         n_threads=n_threads,
@@ -399,6 +441,58 @@ def assemble_window_patch(plan, n_threads=None) -> Patch:
     # _patch_from_block reads, so coordinate construction stays single-
     # sourced with the per-file reader
     return _patch_from_block(plan, data, 0, plan["c_lo"])
+
+
+def _segment_arrays(segments):
+    """ctypes marshaling shared by both native assemblers."""
+    n = len(segments)
+    return (
+        (ctypes.c_char_p * n)(*[os.fsencode(s[0]) for s in segments]),
+        (ctypes.c_uint64 * n)(*[int(s[1]) for s in segments]),
+        (ctypes.c_uint64 * n)(*[int(s[2]) for s in segments]),
+        (ctypes.c_uint64 * n)(*[int(s[3]) for s in segments]),
+        n,
+    )
+
+
+def assemble_window_raw(
+    segments, c_lo, c_hi, total_rows, dtype_code, n_threads=None
+):
+    """Fill one contiguous (total_rows, c_hi-c_lo) buffer of the RAW
+    payload dtype (no numeric conversion) from per-file row segments —
+    the half-bandwidth half of the device-decode ingest path. Every
+    file must carry ``dtype_code`` (the planner guarantees it; the
+    native runtime re-checks per file)."""
+    out = np.empty((total_rows, c_hi - c_lo), _DTYPES[dtype_code])
+    lib = load_streamio()
+    if lib is None:
+        for path, r_lo, r_hi, o0 in segments:
+            hdr = read_tdas_header(path)
+            if hdr["dtype_code"] != dtype_code:
+                raise ValueError(
+                    f"{path}: payload dtype {hdr['dtype_code']} != "
+                    f"planned {dtype_code}"
+                )
+            out[o0 : o0 + (r_hi - r_lo)] = _read_rows_raw_numpy(
+                path, hdr, r_lo, r_hi, c_lo, c_hi
+            )
+        return out
+    paths, row_lo, row_hi, out_r0, n = _segment_arrays(segments)
+    rc = lib.tdas_assemble_window_raw(
+        paths,
+        row_lo,
+        row_hi,
+        out_r0,
+        n,
+        int(c_lo),
+        int(c_hi),
+        int(dtype_code),
+        out.ctypes.data_as(ctypes.c_void_p),
+        int(n_threads or _default_threads()),
+    )
+    if rc != 0:
+        raise OSError(rc, "tdas_assemble_window_raw failed")
+    return out
 
 
 def assemble_window(segments, c_lo, c_hi, total_rows, n_threads=None):
@@ -414,13 +508,7 @@ def assemble_window(segments, c_lo, c_hi, total_rows, n_threads=None):
                 path, hdr, r_lo, r_hi, c_lo, c_hi
             )
         return out
-    n = len(segments)
-    paths = (ctypes.c_char_p * n)(
-        *[os.fsencode(s[0]) for s in segments]
-    )
-    row_lo = (ctypes.c_uint64 * n)(*[int(s[1]) for s in segments])
-    row_hi = (ctypes.c_uint64 * n)(*[int(s[2]) for s in segments])
-    out_r0 = (ctypes.c_uint64 * n)(*[int(s[3]) for s in segments])
+    paths, row_lo, row_hi, out_r0, n = _segment_arrays(segments)
     rc = lib.tdas_assemble_window(
         paths,
         row_lo,

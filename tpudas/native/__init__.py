@@ -96,6 +96,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         p(ctypes.c_char_p), p(u64), p(u64), p(u64), ctypes.c_int, u32, u32,
         p(f32), ctypes.c_int,
     ]
+    lib.tdas_assemble_window_raw.restype = ctypes.c_int
+    lib.tdas_assemble_window_raw.argtypes = [
+        p(ctypes.c_char_p), p(u64), p(u64), p(u64), ctypes.c_int, u32, u32,
+        u32, ctypes.c_void_p, ctypes.c_int,
+    ]
     return lib
 
 

@@ -129,6 +129,36 @@ int read_rows(int fd, const TdasHeader& h, uint64_t t_lo, uint64_t t_hi,
   return 0;
 }
 
+// Raw variant of read_rows: channel-slice memcpy only, NO numeric
+// conversion — feeds the device-decode ingest path, where quantized
+// int16 samples cross PCIe at half the float32 byte count and the TPU
+// does the (cast * scale) decode.
+int read_rows_raw(int fd, const TdasHeader& h, uint64_t t_lo, uint64_t t_hi,
+                  uint32_t c_lo, uint32_t c_hi, unsigned char* out) {
+  const size_t es = dtype_size(h.dtype);
+  const size_t row_bytes = static_cast<size_t>(h.n_ch) * es;
+  const size_t span_ch = c_hi - c_lo;
+  if (c_lo == 0 && c_hi == h.n_ch) {
+    return pread_full(fd, out, (t_hi - t_lo) * row_bytes,
+                      static_cast<off_t>(kHeaderSize + t_lo * row_bytes));
+  }
+  const size_t rows_per_chunk =
+      std::max<size_t>(1, (size_t{8} << 20) / row_bytes);
+  std::vector<unsigned char> buf(rows_per_chunk * row_bytes);
+  for (uint64_t t = t_lo; t < t_hi; t += rows_per_chunk) {
+    const uint64_t n = std::min<uint64_t>(rows_per_chunk, t_hi - t);
+    int rc = pread_full(fd, buf.data(), n * row_bytes,
+                        static_cast<off_t>(kHeaderSize + t * row_bytes));
+    if (rc != 0) return rc;
+    for (uint64_t r = 0; r < n; ++r) {
+      std::memcpy(out + (t - t_lo + r) * span_ch * es,
+                  buf.data() + r * row_bytes + static_cast<size_t>(c_lo) * es,
+                  span_ch * es);
+    }
+  }
+  return 0;
+}
+
 }  // namespace
 
 extern "C" {
@@ -241,6 +271,53 @@ int tdas_assemble_window(const char** paths, const uint64_t* row_lo,
       if (i >= n_files || err.load() != 0) return;
       int rc = tdas_read_block(paths[i], row_lo[i], row_hi[i], c_lo, c_hi,
                                out + out_row0[i] * span_ch, 1);
+      if (rc != 0) err.store(rc);
+    }
+  };
+  if (n_threads < 1) n_threads = 1;
+  if (n_threads > n_files) n_threads = n_files;
+  std::vector<std::thread> workers;
+  for (int i = 0; i < n_threads; ++i) workers.emplace_back(worker);
+  for (auto& w : workers) w.join();
+  return err.load();
+}
+
+// Raw (no-conversion) multi-file window assembly into a payload-dtype
+// buffer: every file must carry `expect_dtype` or the call fails with
+// EINVAL (the planner guarantees uniformity; this re-checks at the
+// byte level). Same worker-pool structure as tdas_assemble_window.
+int tdas_assemble_window_raw(const char** paths, const uint64_t* row_lo,
+                             const uint64_t* row_hi,
+                             const uint64_t* out_row0, int n_files,
+                             uint32_t c_lo, uint32_t c_hi,
+                             uint32_t expect_dtype, unsigned char* out,
+                             int n_threads) {
+  if (n_files < 0) return EINVAL;
+  if (expect_dtype != 0 && expect_dtype != 1) return EINVAL;
+  const size_t es = dtype_size(expect_dtype);
+  std::atomic<int> next{0};
+  std::atomic<int> err{0};
+  const size_t span_ch = c_hi - c_lo;
+  auto worker = [&]() {
+    for (;;) {
+      int i = next.fetch_add(1);
+      if (i >= n_files || err.load() != 0) return;
+      int fd = open(paths[i], O_RDONLY);
+      if (fd < 0) {
+        err.store(errno ? errno : EIO);
+        return;
+      }
+      TdasHeader h;
+      int rc = read_header_fd(fd, &h);
+      if (rc == 0 && h.dtype != expect_dtype) rc = EINVAL;
+      if (rc == 0 &&
+          (row_hi[i] > h.n_time || c_hi > h.n_ch || row_lo[i] > row_hi[i] ||
+           c_lo > c_hi))
+        rc = ERANGE;
+      if (rc == 0)
+        rc = read_rows_raw(fd, h, row_lo[i], row_hi[i], c_lo, c_hi,
+                           out + out_row0[i] * span_ch * es);
+      close(fd);
       if (rc != 0) err.store(rc);
     }
   };

@@ -23,9 +23,11 @@ post-decimation Nyquist, matching lf_das.py:223.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import os
 import shutil
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 import jax
@@ -104,6 +106,14 @@ def schedule_windows(n_grid: int, patch_size: int, buff_size: int):
     return windows
 
 
+@jax.jit
+def _decode_i16_kernel(x, scale):
+    """Dequantize int16 samples on DEVICE: the host transfers half the
+    bytes and the cast*scale runs at HBM speed. Bit-identical to the
+    host reader's ``raw.astype(float32) * float32(scale)``."""
+    return x.astype(jnp.float32) * scale
+
+
 @functools.partial(jax.jit, static_argnames=("nfft", "order"))
 def _lowpass_resample_kernel(data, d_sec, corner, idx, w, nfft, order):
     """Fused window kernel: zero-phase low-pass + gather-lerp decimate.
@@ -157,6 +167,11 @@ class LFProc:
         # "cascade-pallas" when any of its stages ran the Pallas kernel,
         # "cascade-xla" otherwise; FFT-path windows count as "fft"
         self.engine_counts = {"cascade-pallas": 0, "cascade-xla": 0, "fft": 0}
+        # cumulative per-phase wall seconds (SURVEY.md §5 tracing row:
+        # "device-time breakdown per window"): assemble = waiting on
+        # the prefetch thread's window read, device = kernel dispatch
+        # through host-side result sync, write = HDF5 output
+        self.timings = {"assemble_s": 0.0, "device_s": 0.0, "write_s": 0.0}
 
     # configuration ----------------------------------------------------
     def _default_process_parameters(self):
@@ -269,6 +284,7 @@ class LFProc:
                     "native_window",
                     files=len(plan["segments"]),
                     rows=plan["total_rows"],
+                    payload=plan.get("payload", "float32"),
                 )
                 return assemble_window_patch(plan)
         selected = self._spool.select(time=(t_lo, t_hi))
@@ -359,6 +375,28 @@ class LFProc:
                 )
         else:
             segments = [(0, len(time_grid))]
+        # TPUDAS_TRACE_DIR: capture a device profiler trace of the whole
+        # run (jax.profiler; SURVEY.md §5 tracing row)
+        trace_dir = os.environ.get("TPUDAS_TRACE_DIR")
+        if trace_dir:
+            from tpudas.utils.profiling import device_trace
+
+            trace_cm = device_trace(trace_dir)
+        else:
+            trace_cm = contextlib.nullcontext()
+        with trace_cm:
+            total_windows = self._process_segments(
+                time_grid, segments, on_gap
+            )
+        log_event(
+            "process_time_range_done",
+            windows=total_windows,
+            grid_points=len(time_grid),
+            segments=len(segments),
+            timings={k: round(v, 4) for k, v in self.timings.items()},
+        )
+
+    def _process_segments(self, time_grid, segments, on_gap) -> int:
         total_windows = 0
         for s_i, (g_lo, g_hi) in enumerate(segments):
             if len(segments) > 1:
@@ -375,12 +413,7 @@ class LFProc:
             total_windows += self._process_segment(
                 time_grid[g_lo:g_hi], on_gap
             )
-        log_event(
-            "process_time_range_done",
-            windows=total_windows,
-            grid_points=len(time_grid),
-            segments=len(segments),
-        )
+        return total_windows
 
     def _process_segment(self, time_grid, on_gap) -> int:
         """Overlap-save over one contiguous grid segment; returns the
@@ -410,7 +443,11 @@ class LFProc:
                 )
             for i, (sel_lo, sel_hi, emit_lo, emit_hi) in enumerate(windows):
                 print("Processing patch ", str(i + 1))
+                t_wait = time.perf_counter()
                 window_patch = future.result()
+                self.timings["assemble_s"] += (
+                    time.perf_counter() - t_wait
+                )
                 if i + 1 < len(windows):
                     nxt = windows[i + 1]
                     future = pool.submit(
@@ -572,7 +609,16 @@ class LFProc:
             emitted=n_out,
             mesh=None if mesh is None else dict(mesh.shape),
         )
-        host32 = host.astype(np.float32, copy=False)
+        qscale = window_patch.attrs.get("data_scale")
+        t_dev0 = time.perf_counter()
+        if host.dtype == np.int16 and qscale is not None:
+            # quantized window (tdas int16 fast path): ship the raw
+            # int16 across H2D and decode on device
+            host32 = _decode_i16_kernel(
+                jax.device_put(host), jnp.float32(qscale)
+            )
+        else:
+            host32 = host.astype(np.float32, copy=False)
         if align is not None:
             out = None
             if time_layout is not None:
@@ -601,7 +647,10 @@ class LFProc:
                 # unaffected) and trimmed below.
                 pad_c = -n_ch % mesh.shape["ch"]
                 if pad_c:
-                    data = np.pad(data, ((0, 0), (0, pad_c)))
+                    pad_fn = (
+                        jnp.pad if isinstance(data, jax.Array) else np.pad
+                    )
+                    data = pad_fn(data, ((0, 0), (0, pad_c)))
                 data = jax.device_put(
                     data, NamedSharding(mesh, P(None, "ch"))
                 )
@@ -610,14 +659,29 @@ class LFProc:
             )
             if pad_c:
                 out = out[:, :n_ch]
-        out = np.asarray(out)
+        out = np.asarray(out)  # forces the device chain (host sync)
+        t_dev = time.perf_counter() - t_dev0
+        self.timings["device_s"] += t_dev
         if ax != 0:
             out = np.moveaxis(out, 0, ax)
         coords = dict(window_patch.coords)
         coords["time"] = target_times
-        result = window_patch.new(data=out, coords=coords)
+        attrs = window_patch.attrs.to_dict()
+        # the output is decoded float32 — a quantization scale inherited
+        # from an int16 ingest window would misdescribe it
+        attrs.pop("data_scale", None)
+        result = window_patch.new(data=out, coords=coords, attrs=attrs)
         result = result.update_attrs(d_time=dt)
         filename = get_filename(
             result.attrs["time_min"], result.attrs["time_max"]
         )
+        t_w0 = time.perf_counter()
         result.io.write(os.path.join(self._output_folder, filename), "dasdae")
+        t_write = time.perf_counter() - t_w0
+        self.timings["write_s"] += t_write
+        log_event(
+            "window_timing",
+            device_s=round(t_dev, 5),
+            write_s=round(t_write, 5),
+            engine=ran,
+        )

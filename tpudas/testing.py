@@ -94,6 +94,7 @@ def make_synthetic_spool(
     start=DEFAULT_T0,
     format="dasdae",
     prefix="raw",
+    write_kwargs=None,
     **kwargs,
 ):
     """Write ``n_files`` contiguous files into ``directory`` in the
@@ -102,6 +103,8 @@ def make_synthetic_spool(
     ``prefix`` names the files ``<prefix>_<i>.<ext>`` — pass a distinct
     prefix when appending a later batch into an existing directory
     (streaming tests), or the new files would overwrite the old.
+    ``write_kwargs`` forwards to the format writer (e.g.
+    ``{"dtype": "int16", "scale": 1e-3}`` for a quantized tdas spool).
     """
     os.makedirs(directory, exist_ok=True)
     t0 = to_datetime64(start).astype("datetime64[ns]")
@@ -121,6 +124,6 @@ def make_synthetic_spool(
             **kwargs,
         )
         path = os.path.join(directory, f"{prefix}_{i:04d}{suffix}")
-        write_patch(patch, path, format=format)
+        write_patch(patch, path, format=format, **(write_kwargs or {}))
         paths.append(path)
     return paths

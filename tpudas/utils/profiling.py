@@ -51,3 +51,33 @@ class Counters:
         """Data-seconds processed per wall-second (>1 means faster than
         the stream)."""
         return self.data_seconds / self.wall_seconds if self.wall_seconds else 0.0
+
+
+@contextmanager
+def device_trace(logdir):
+    """Capture a device-level profiler trace (TensorBoard format) of
+    the enclosed block via ``jax.profiler`` — the rebuild's upgrade of
+    the reference's wall-clock tic/toc (SURVEY.md §5 tracing row).
+
+    Robust by design: a backend without profiler support logs a
+    ``trace_failed`` event and the block still runs.
+    """
+    import jax
+
+    from tpudas.utils.logging import log_event
+
+    started = False
+    try:
+        jax.profiler.start_trace(str(logdir))
+        started = True
+    except Exception as exc:  # pragma: no cover - backend specific
+        log_event("trace_failed", error=str(exc)[:200])
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+                log_event("trace_written", logdir=str(logdir))
+            except Exception as exc:  # pragma: no cover
+                log_event("trace_failed", error=str(exc)[:200])
