@@ -85,6 +85,7 @@ real-time factor beside the resident-kernel number.
 
 Env knobs: BENCH_T, BENCH_C, BENCH_ITERS, BENCH_ENGINE,
 BENCH_PALLAS=0/1, BENCH_INCLUDE_H2D=0/1, BENCH_COMPARE=0/1,
+BENCH_PROFILE=0/1 (per-stage cascade breakdown),
 BENCH_MODE=kernel/e2e, BENCH_E2E_SEC, BENCH_E2E_FS, BENCH_E2E_TIMEOUT,
 BENCH_BUDGET (total parent wall budget, s), BENCH_PROBE_TIMEOUT,
 BENCH_CHILD_TIMEOUT.
@@ -378,6 +379,10 @@ def _build_cascade_step(T, C, fs, dt_out, order, use_pallas, mesh=None,
         "stages": [[e, k] for e, k in layout],
         "stages_scope": "per_shard" if shards > 1 else "global",
         "emitted_k_factor": shards,
+        # for BENCH_PROFILE: the exact plan/layout the headline number
+        # measured (re-deriving them would silently drift)
+        "plan": plan,
+        "layout": layout,
     }
     return (lambda data: fn(data)), flops, T_used, report
 
@@ -644,6 +649,61 @@ def _child() -> None:
         result["mesh"] = mesh_info
     if peak and backend != "cpu":
         result["mfu"] = round(flops_per_sec / peak, 4)
+
+    # Optional per-stage breakdown (BENCH_PROFILE=1): each cascade
+    # stage measured alone at its in-chain input shape, same scan
+    # harness — shows where the window's time goes on real hardware.
+    # Budget-gated like the compare block: running out of watchdog
+    # budget mid-profile must not cost the already-computed headline.
+    profile = (
+        os.environ.get("BENCH_PROFILE", "0") == "1"
+        and engine == "cascade"
+        and mesh is None
+        and not include_h2d
+    )
+    if profile:
+        left = remaining - (time.monotonic() - child_start)
+        if left <= _COMPARE_MIN_LEFT:
+            result["profile_skipped"] = (
+                f"budget: {left:.0f}s left < {_COMPARE_MIN_LEFT}s"
+            )
+            profile = False
+    if profile:
+        from tpudas.ops.fir import (
+            _blocked_taps,
+            _pallas_interpret,
+            _polyphase_stage_xla,
+        )
+        from tpudas.ops.pallas_fir import fir_decimate_pallas
+
+        # profile exactly the plan/layout the headline measured
+        plan = report["plan"]
+        layout_s = report["layout"]
+        interpret = _pallas_interpret()
+        stage_ms = []
+        t_in = T_used
+        prof_iters = max(8, iters // 4)
+        for (R, hb), (eng2, k) in zip(_blocked_taps(plan), layout_s):
+            if eng2 == "pallas":
+                def stage_fn(x, hb=hb, R=R, k=k):
+                    return fir_decimate_pallas(
+                        x, hb, int(R), n_out=k, interpret=interpret
+                    )
+            else:
+                def stage_fn(x, hb=hb, R=R, k=k):
+                    return _polyphase_stage_xla(x, hb, int(R), k)
+            try:
+                dt_s, n_done, _ = _measure(stage_fn, t_in, C, prof_iters,
+                                           False)
+                stage_ms.append(
+                    [eng2, int(t_in), round(dt_s / n_done * 1e3, 3)]
+                )
+            except Exception as exc:
+                stage_ms.append([eng2, int(t_in), f"error: {exc}"[:80]])
+            t_in = k
+        result["stage_times_ms"] = stage_ms
+        print(f"[bench] stage profile: {stage_ms}", file=sys.stderr,
+              flush=True)
 
     # Optional engine shoot-out (small iters) so 'auto' is data-driven.
     # Gate on the time ACTUALLY left (remaining was frozen at child

@@ -32,13 +32,25 @@ from tpudas.testing import make_synthetic_spool
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--workdir", default=None)
-    ap.add_argument("--time-shards", type=int, default=2)
+    ap.add_argument(
+        "--time-shards", type=int, default=None,
+        help="explicit time shards (must divide the device count); "
+        "default: 2 when the device count allows, else 1",
+    )
     ap.add_argument("--fs", type=float, default=500.0)
     ap.add_argument("--n-ch", type=int, default=64)
     args = ap.parse_args()
 
     n_dev = device_count()
-    time_shards = args.time_shards if n_dev % args.time_shards == 0 else 1
+    if args.time_shards is None:
+        time_shards = 2 if n_dev >= 2 and n_dev % 2 == 0 else 1
+    elif args.time_shards < 1 or n_dev % args.time_shards != 0:
+        ap.error(
+            f"--time-shards must be a positive divisor of the device "
+            f"count ({n_dev}); got {args.time_shards}"
+        )
+    else:
+        time_shards = args.time_shards
     mesh = make_mesh(n_dev, time_shards=time_shards)
     print(f"mesh: {dict(mesh.shape)} over {n_dev} devices")
 

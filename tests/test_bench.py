@@ -66,6 +66,20 @@ class TestCompareVisibility:
             assert isinstance(value, (int, float)), (name, value)
         assert "engines_skipped" not in result
 
+    def test_stage_profile_breakdown(self, monkeypatch, capsys):
+        result = _run_child(
+            monkeypatch, capsys, BENCH_PROFILE="1", BENCH_T="30000",
+            BENCH_C="16",
+        )
+        stages = result["stage_times_ms"]
+        assert len(stages) == len(result["stages"])
+        for eng, t_in, ms in stages:
+            assert eng in ("pallas", "xla")
+            assert isinstance(ms, float) and ms > 0, (eng, t_in, ms)
+        # input sizes shrink monotonically through the cascade
+        sizes = [t_in for _, t_in, _ in stages]
+        assert sizes == sorted(sizes, reverse=True)
+
     def test_no_compare_no_keys(self, monkeypatch, capsys):
         result = _run_child(monkeypatch, capsys, BENCH_COMPARE="0")
         assert "engines" not in result
