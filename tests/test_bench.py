@@ -88,6 +88,25 @@ class TestCompareVisibility:
         assert result["metric"] == "channel_samples_per_sec"
 
 
+class TestE2EChild:
+    def test_int16_payload_e2e(self, monkeypatch, capsys):
+        """BENCH_E2E_DTYPE=int16 runs the quantized product path:
+        raw native assembly + device decode, recorded in the JSON."""
+        result = _run_child(
+            monkeypatch,
+            capsys,
+            BENCH_MODE="e2e",
+            BENCH_E2E_DTYPE="int16",
+            BENCH_E2E_SEC="30",
+            BENCH_C="16",
+            BENCH_E2E_FS="200",
+        )
+        assert result["mode"] == "e2e"
+        assert result["payload"] == "int16"
+        assert result["native_windows"] >= 1
+        assert result["realtime_factor"] > 0
+
+
 class TestParentFlow:
     def test_kernel_line_carries_e2e_subobject(self):
         """One `python bench.py` run records BOTH the resident-kernel
