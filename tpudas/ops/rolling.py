@@ -27,7 +27,7 @@ import numpy as np
 
 from tpudas.core import units as _units
 
-__all__ = ["PatchRoller", "rolling_reduce"]
+__all__ = ["PatchRoller", "rolling_reduce", "rolling_mean_patches_batched"]
 
 
 def _window_step_samples(window_sec, step_sec, d_sec):
@@ -207,3 +207,53 @@ class PatchRoller:
         out = xp.sqrt(var)
         coords, attrs = self._stepped_coords_attrs(p)
         return p.new(data=out, coords=coords, attrs=attrs)
+
+
+def rolling_mean_patches_batched(mesh, patches, window, step):
+    """Data-parallel rolling mean of shape-uniform patches over the
+    mesh's ``ch`` axis (SURVEY §2.4 DP row: independent patches are the
+    trivial parallel axis). The batch is zero-padded to the shard
+    multiple and trimmed after; per-patch output is byte-identical to
+    the single-patch jax engine (same reduce_window kernel, vmapped).
+
+    Lives beside :class:`PatchRoller` so the window/step derivation and
+    coords/attrs reconstruction have exactly one owner. Returns the
+    list of result patches, or ``None`` when the batch is not uniform
+    enough to stack (callers fall back to per-patch).
+    """
+    from tpudas.parallel.batch import batched_rolling_mean
+
+    first = patches[0]
+    ax = first.axis_of("time")
+    if any(
+        p.shape != first.shape
+        or p.dims != first.dims
+        or p.get_sample_step("time") != first.get_sample_step("time")
+        for p in patches
+    ):
+        return None
+    # one PatchRoller per patch: validates and owns (w, s) + the
+    # stepped coords/attrs semantics (uniform by the check above)
+    rollers = [p.rolling(time=window, step=step) for p in patches]
+    w, s = rollers[0].window, rollers[0].step
+    stack = np.stack(
+        [
+            np.moveaxis(p.host_data(), ax, 0) if ax != 0 else p.host_data()
+            for p in patches
+        ]
+    )
+    nb = mesh.shape["ch"]
+    pad_b = -len(patches) % nb
+    if pad_b:
+        stack = np.concatenate(
+            [stack, np.zeros((pad_b,) + stack.shape[1:], stack.dtype)]
+        )
+    out = np.asarray(batched_rolling_mean(mesh, stack, w=w, s=s))
+    results = []
+    for i, (p, roller) in enumerate(zip(patches, rollers)):
+        data = out[i]
+        if ax != 0:
+            data = np.moveaxis(data, 0, ax)
+        coords, attrs = roller._stepped_coords_attrs(p)
+        results.append(p.new(data=data, coords=coords, attrs=attrs))
+    return results

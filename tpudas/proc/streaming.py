@@ -216,6 +216,12 @@ def run_lowpass_realtime(
     return rounds
 
 
+# fresh patches processed per batched-rolling chunk: bounds the host
+# stack (a first poll over a large pre-existing archive makes EVERY
+# file fresh at once) while still amortizing the batched dispatch
+_ROLLING_BATCH_CHUNK = 32
+
+
 def run_rolling_realtime(
     source,
     output_folder,
@@ -228,12 +234,26 @@ def run_rolling_realtime(
     max_rounds=None,
     sleep_fn=_time.sleep,
     engine=None,
+    mesh=None,
 ):
     """Poll ``source`` and rolling-mean each NEW patch (stateless per
     file — rolling_mean_dascore_edge.ipynb:209-221). Returns rounds
-    that processed data."""
+    that processed data.
+
+    ``mesh`` batches each round's fresh patches over the mesh's ``ch``
+    axis (pure data parallelism, no collectives) in bounded chunks,
+    whenever the chunk is shape-uniform and ``engine`` is not a host
+    engine ("numpy"/"host" forces the per-patch host path);
+    non-uniform chunks fall back to the per-patch device path.
+    """
     import os
 
+    if mesh is not None and "ch" not in mesh.shape:
+        raise ValueError(
+            "run_rolling_realtime mesh needs a 'ch' axis (use "
+            "tpudas.parallel.mesh.make_mesh); got axes "
+            f"{tuple(mesh.shape)}"
+        )
     os.makedirs(output_folder, exist_ok=True)
     interval = float(poll_interval) if poll_interval is not None else float(
         file_duration
@@ -261,18 +281,50 @@ def run_rolling_realtime(
         if fresh:
             rounds += 1
             print("run number: ", rounds)
-            for j in fresh:
-                patch = sub[j]
-                print("working on patch ", j)
-                out = patch.rolling(
-                    time=window, step=step, engine=engine
-                ).mean()
+
+            def write_out(j, out):
                 out = out.new(data=np.asarray(out.data) * scale)
                 fname = get_filename(
                     out.attrs["time_min"], out.attrs["time_max"]
                 )
                 out.io.write(os.path.join(output_folder, fname), "dasdae")
                 processed.add(keys[j])
+
+            # bounded chunks: memory stays O(chunk), outputs are
+            # written as soon as they are computed
+            for c0 in range(0, len(fresh), _ROLLING_BATCH_CHUNK):
+                chunk = fresh[c0 : c0 + _ROLLING_BATCH_CHUNK]
+                outs = None
+                if (
+                    mesh is not None
+                    and engine not in ("numpy", "host")
+                    and len(chunk) > 1
+                ):
+                    from tpudas.ops.rolling import (
+                        rolling_mean_patches_batched,
+                    )
+
+                    patches = [sub[j] for j in chunk]
+                    outs = rolling_mean_patches_batched(
+                        mesh, patches, window, step
+                    )
+                    if outs is not None:
+                        log_event(
+                            "rolling_batched",
+                            patches=len(chunk),
+                            mesh=dict(mesh.shape),
+                        )
+                        for j, out in zip(chunk, outs):
+                            write_out(j, out)
+                if outs is None:
+                    for j in chunk:
+                        print("working on patch ", j)
+                        write_out(
+                            j,
+                            sub[j]
+                            .rolling(time=window, step=step, engine=engine)
+                            .mean(),
+                        )
         initial_run = False
         if max_rounds is not None and polls >= max_rounds:
             break
