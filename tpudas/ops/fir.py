@@ -314,16 +314,17 @@ def cascade_input_need(plan: CascadePlan, n_out: int) -> int:
 def _pallas_stage_ok(k: int, R: int, n_ch: int, n_frames: int) -> bool:
     """Pallas only for stages that are big enough to matter: small
     stages measure slower under the kernel (grid overheads dominate)
-    AND their 128-frame grid rounding inflates every upstream stage's
-    output count through the chain layout. Thresholds from the v5e
-    measurements behind BENCH_r04: >= 2^24 elements touched and a
-    reasonably full first grid step. Taps must also fit the kernel's
-    128-frame block; very long single-stage plans (possible via the
-    public design API) take the XLA polyphase path instead of
-    erroring."""
+    AND their grid rounding — the kernel's quantum is 512 output
+    frames (4 parallel 128-frame sub-blocks per step) — inflates
+    every upstream stage's output count through the chain layout.
+    Thresholds from the v5e measurements behind BENCH_r04: >= 2^24
+    elements touched and a full first grid step. Taps must also fit
+    the kernel's 128-frame sub-block; very long single-stage plans
+    (possible via the public design API) take the XLA polyphase path
+    instead of erroring."""
     return (
         k * R * n_ch >= (1 << 24)
-        and k >= 128
+        and k >= 512
         and n_frames <= 128
     )
 

@@ -8,23 +8,40 @@ a (T, C) block and frame-blocked taps ``hb`` (B, R),
 i.e. a causal FIR of length <= B*R evaluated only at stride-R output
 positions — the op the reference executes as full-rate ``sosfiltfilt``
 + decimating ``interpolate`` (lf_das.py:223-225) and XLA executes as
-B shifted matmuls with B full HBM passes. The kernel reads each input
-element exactly once into VMEM and does all B shifted reductions
-on-chip.
+B shifted matmuls with B full HBM passes.
 
-Layout: the input is viewed as frames ``(K + halo, R, C)`` (a free
-reshape — time-major data is already contiguous). The grid is
-``(K/KB, C/CB)``; each program gets its main frame block ``(KB, R, CB)``
-plus a ``(HALO_F, R, CB)`` halo block that is simply the head of the
-next main block, expressed as a second BlockSpec over the same array
-(possible because HALO_F divides KB, so the halo offset is an integer
-block index). Mosaic double-buffers both streams automatically.
+Design (v2, informed by on-chip measurement — see PERF.md §5):
 
-Tiling: KB=128 frames, CB=128 lanes (f32 min tile is (8, 128); R is
-the middle dim of the 3-D block). The tap table rides along as a
-(HALO_F, R) VMEM operand. VMEM per program at R=8:
-128*8*128*4B = 512 KB main + 32 KB halo + 64 KB out — comfortably
-inside the ~16 MB budget even with double buffering.
+- **MXU banded matmul, not VPU shifted adds.**  For an SB-frame output
+  sub-block the FIR is one dot ``Y = A @ X`` with
+  ``A[k, k*R + j] = h[j]`` the (SB, (SB+HALO)*R) banded tap matrix and
+  ``X`` the flat 2-D view of the input rows.  A is ~96% zeros, but the
+  MXU has ~50x the VPU's throughput: the VPU formulation measured
+  compute-bound at 174 GB/s while this one is bound by the DMA stream.
+  A rides along as a grid-constant input (index map (0,0)): the
+  pipeline fetches it once and skips the re-DMA on later steps.
+- **P parallel input streams.**  A single auto-pipelined input block
+  measured ~185 GB/s regardless of block geometry (one DMA in flight
+  can't cover HBM latency).  Each grid step therefore reads P separate
+  main blocks — P views of the same array at consecutive block
+  indices, each with its own double buffer and in-flight DMA.
+- **f32 accuracy via a 3-pass bf16 split** (hi/lo split of both
+  operands, dropping lo*lo): Mosaic lowers only DEFAULT (1-pass bf16,
+  ~3e-3 abs error on unit-scale data — too coarse) and HIGHEST
+  (6-pass); 3 passes give ~1e-5 at half HIGHEST's MXU cost.  Interpret
+  mode (the CPU test path) uses exact f32 dots instead, so CPU
+  equality tests see the mathematically exact kernel.
+
+Layout: the halo of main block j is the head of main block j+1 — for
+j < P-1 that block is already resident in the same grid step, so only
+the LAST sub-block needs a dedicated halo input (the head of the next
+step's first main block, expressed as a second BlockSpec over the same
+array; possible because HALO_F divides SB, so the halo offset is an
+integer block index).
+
+VMEM at (P, SB, CB) = (4, 128, 128), R=8: 4 mains x 512 KB x 2
+(double-buffered) + A 557 KB + out 256 KB x 2 + halo 32 KB x 2 — about
+6 MB of the ~16 MB budget.
 """
 
 from __future__ import annotations
@@ -37,9 +54,11 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["fir_decimate_pallas"]
+__all__ = ["fir_decimate_pallas", "stage_input_rows"]
 
-_KB = 128  # output frames per program (sublane-aligned multiple of 8)
+_SB = 128  # output frames per sub-block (one MXU dot)
+_P = 4  # parallel main-block streams per grid step
+_KB = _SB * _P  # output frames per grid step (the grid quantum)
 _CB = 128  # channels per program (lane width)
 
 
@@ -47,12 +66,12 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
-def _halo_frames(B: int, kb: int) -> int:
-    """Halo block frames: B rounded up to a sublane multiple that also
-    divides the main block (so the halo offset is an integer block
+def _halo_frames(B: int, sb: int = _SB) -> int:
+    """Halo frames: B rounded up to a sublane multiple that also
+    divides the sub-block (so the halo offset is an integer block
     index). Single source for both the kernel and the sizing math."""
     halo_f = _round_up(B, 8)
-    while halo_f <= kb and kb % halo_f != 0:
+    while halo_f <= sb and sb % halo_f != 0:
         halo_f += 8
     return halo_f
 
@@ -63,44 +82,89 @@ def stage_input_rows(B: int, R: int, n_out: int, kb: int = _KB) -> int:
     exactly this many rows makes the kernel pad-free (the internal
     ``jnp.pad`` otherwise materializes a full copy of the input, which
     at engine scale is an extra HBM round-trip per stage)."""
-    return (_round_up(int(n_out), kb) + _halo_frames(B, kb)) * R
+    sb = min(int(kb), _SB)
+    return (_round_up(int(n_out), kb) + _halo_frames(B, sb)) * R
 
 
-def _kernel_body(B, KB, CB):
-    def kernel(hb_ref, xm_ref, xh_ref, out_ref):
-        full = jnp.concatenate([xm_ref[:], xh_ref[:]], axis=0)
-        acc = jnp.zeros((KB, CB), jnp.float32)
-        for b in range(B):
-            acc = acc + jnp.sum(
-                full[b : b + KB] * hb_ref[b][None, :, None], axis=1
+def _split_bf16(v):
+    hi = v.astype(jnp.bfloat16)
+    lo = (v - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    return hi, lo
+
+
+def _dot_3x(a, x):
+    """~f32-accurate matmul from 3 bf16 MXU passes (drops lo*lo)."""
+    a_hi, a_lo = _split_bf16(a)
+    x_hi, x_lo = _split_bf16(x)
+    d = functools.partial(jnp.dot, preferred_element_type=jnp.float32)
+    return d(a_hi, x_hi) + d(a_hi, x_lo) + d(a_lo, x_hi)
+
+
+def _dot_f32(a, x):
+    return jnp.dot(
+        a,
+        x,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+
+
+def _kernel_body(P, SB, CB, halo_rows, exact):
+    dot = _dot_f32 if exact else _dot_3x
+
+    def kernel(*refs):
+        a_ref = refs[0]
+        mains = refs[1 : 1 + P]
+        halo_ref = refs[1 + P]
+        out_ref = refs[2 + P]
+        for j in range(P):
+            head = (
+                mains[j + 1][:halo_rows]
+                if j < P - 1
+                else halo_ref[:]
             )
-        out_ref[:] = acc
+            x = jnp.concatenate([mains[j][:], head], axis=0)
+            out_ref[j * SB : (j + 1) * SB] = dot(a_ref[:], x)
 
     return kernel
 
 
-@functools.partial(
-    jax.jit, static_argnames=("R", "n_out", "interpret", "kb", "cb")
-)
+@functools.lru_cache(maxsize=64)
+def _band_matrix(taps: tuple, R: int, SB: int, rows: int) -> np.ndarray:
+    h = np.asarray(taps, np.float32)
+    A = np.zeros((SB, rows), np.float32)
+    for k in range(SB):
+        A[k, k * R : k * R + len(h)] = h
+    return A
+
+
 def fir_decimate_pallas(
     x, hb, R: int, n_out: int, interpret: bool = False, kb=_KB, cb=_CB
 ):
     """Strided FIR: x (T, C) f32, hb (B, R) f32 -> (n_out, C) f32.
 
-    ``n_out`` is static; the input is zero-padded on the right as
-    needed (outputs whose receptive field crosses the pad carry edge
-    artifacts, trimmed by the overlap-save caller). Falls back to
-    whole-block zero padding for channel counts that are not multiples
-    of the 128-lane tile.
+    ``hb`` must be CONCRETE (host numpy or a settled device array, not
+    a tracer): the banded tap matrix is built on the host.  ``x`` may
+    be traced — callers jit the enclosing cascade.  ``n_out`` is
+    static; the input is zero-padded on the right as needed (outputs
+    whose receptive field crosses the pad carry edge artifacts,
+    trimmed by the overlap-save caller), and channel counts that are
+    not multiples of the lane tile get whole-block zero padding.
+    ``kb`` is the grid quantum in output frames (P parallel sub-blocks
+    of min(kb, 128) frames each); ``cb`` the channel block.
     """
     B = int(hb.shape[0])
     T, C = x.shape
     KB, CB = int(kb), int(cb)
-    halo_f = _halo_frames(B, KB)
-    if halo_f > KB:
+    SB = min(KB, _SB)
+    P = KB // SB
+    if KB % SB:
+        raise ValueError(f"kb ({KB}) must be a multiple of {SB}")
+    halo_f = _halo_frames(B, SB)
+    if halo_f > SB:
         raise ValueError(
-            f"tap frames ({B}) exceed the kernel block ({KB} frames); "
-            "use the XLA polyphase path for very long stages"
+            f"tap frames ({B}) exceed the kernel sub-block ({SB} "
+            "frames); use the XLA polyphase path for very long stages"
         )
 
     nk = -(-int(n_out) // KB)
@@ -111,35 +175,45 @@ def fir_decimate_pallas(
     pad_c = nc * CB - C
     if pad_t > 0 or pad_c > 0:
         x = jnp.pad(x, ((0, max(pad_t, 0)), (0, pad_c)))
-    xr = x[:need_rows].reshape(Kpad + halo_f, R, nc * CB)
+    x2 = x[:need_rows]
 
-    hb_pad = jnp.zeros((halo_f, R), jnp.float32).at[:B].set(
-        hb.astype(jnp.float32)
+    # frame-blocked taps (B, R) flatten back to the padded tap vector
+    taps = tuple(np.asarray(jax.device_get(hb), np.float32).reshape(-1))
+    band_rows = (SB + halo_f) * R
+    A = jnp.asarray(_band_matrix(taps, R, SB, band_rows))
+
+    halo_rows = halo_f * R
+    step = SB * P // halo_f  # halo offset in halo-block units
+
+    main_specs = [
+        pl.BlockSpec(
+            (SB * R, CB),
+            (lambda k, c, j=j: (k * P + j, c)),
+            memory_space=pltpu.VMEM,
+        )
+        for j in range(P)
+    ]
+    halo_spec = pl.BlockSpec(
+        (halo_rows, CB),
+        lambda k, c, _s=step: (k * _s + _s, c),
+        memory_space=pltpu.VMEM,
     )
-    step = KB // halo_f
-
     out = pl.pallas_call(
-        _kernel_body(B, KB, CB),
+        _kernel_body(P, SB, CB, halo_rows, exact=interpret),
         grid=(nk, nc),
         in_specs=[
             pl.BlockSpec(
-                (halo_f, R), lambda k, c: (0, 0), memory_space=pltpu.VMEM
-            ),
-            pl.BlockSpec(
-                (KB, R, CB),
-                lambda k, c: (k, 0, c),
+                (SB, band_rows),
+                lambda k, c: (0, 0),
                 memory_space=pltpu.VMEM,
             ),
-            pl.BlockSpec(
-                (halo_f, R, CB),
-                lambda k, c, _s=step: (k * _s + _s, 0, c),
-                memory_space=pltpu.VMEM,
-            ),
+            *main_specs,
+            halo_spec,
         ],
         out_specs=pl.BlockSpec(
             (KB, CB), lambda k, c: (k, c), memory_space=pltpu.VMEM
         ),
         out_shape=jax.ShapeDtypeStruct((Kpad, nc * CB), jnp.float32),
         interpret=interpret,
-    )(hb_pad, xr, xr)
+    )(A, *([x2] * P), x2)
     return out[:n_out, :C]
