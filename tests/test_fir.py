@@ -203,6 +203,79 @@ class TestPallasKernel:
         assert np.abs(got - ref).max() < 1e-4 * np.abs(ref).max()
 
 
+class TestQuantizedIngest:
+    """int16 windows flow through the cascade undecoded: the first
+    kernel dequantizes (Pallas: scale folded into the tap matrix;
+    XLA: fused cast*scale) — tpudas.io.tdas raw ingest fast path."""
+
+    def _quantized(self, T, C, seed=0, scale=1e-3):
+        rng = np.random.default_rng(seed)
+        q = rng.integers(-3000, 3000, size=(T, C)).astype(np.int16)
+        return q, np.float32(scale)
+
+    def test_pallas_kernel_raw_int16_matches_decoded(self):
+        """The kernel filters the raw int16 payload (bare cast in
+        VMEM); the caller scales the decimated output — linearity."""
+        from tpudas.ops.fir import _block_taps
+        from tpudas.ops.pallas_fir import fir_decimate_pallas
+
+        rng = np.random.default_rng(2)
+        T, C, R, L = 6000, 64, 4, 19
+        q, s = self._quantized(T, C)
+        h = rng.standard_normal(L).astype(np.float32)
+        hb = _block_taps(h, R)
+        dec = (q.astype(np.float32) * s).astype(np.float32)
+        ref = np.asarray(
+            fir_decimate_pallas(
+                jnp.asarray(dec), hb, R, n_out=512, interpret=True
+            )
+        )
+        got = s * np.asarray(
+            fir_decimate_pallas(
+                jnp.asarray(q), hb, R, n_out=512, interpret=True
+            )
+        )
+        scale_ref = np.abs(ref).max()
+        assert np.abs(got - ref).max() < 1e-6 * scale_ref
+
+    def test_cascade_qscale_single_compile_across_scales(self):
+        """Different quantization scales must NOT trigger distinct
+        cascade compiles: the scale is a traced operand."""
+        from tpudas.ops.fir import _build_cascade_fn
+
+        plan = design_cascade(100.0, 20, CORNER, 4)
+        q, _ = self._quantized(8000, 10, seed=4)
+        _build_cascade_fn.cache_clear()
+        for s in (1e-3, 2e-3, 5e-4):
+            cascade_decimate(
+                jnp.asarray(q), plan, 300, 200, "xla", qscale=s
+            )
+        info = _build_cascade_fn.cache_info()
+        assert info.misses == 1, info
+
+    def test_cascade_qscale_bitwise_matches_decoded(self):
+        """On the XLA path the fused cast*scale is the same sequence of
+        float ops as decode-then-cascade: results are bit-identical."""
+        plan = design_cascade(100.0, 20, CORNER, 4)
+        q, s = self._quantized(8000, 10, seed=3)
+        dec = q.astype(np.float32) * s
+        ref = np.asarray(cascade_decimate(dec, plan, 300, 200, "xla"))
+        got = np.asarray(
+            cascade_decimate(
+                jnp.asarray(q), plan, 300, 200, "xla", qscale=float(s)
+            )
+        )
+        assert np.array_equal(got, ref)
+
+    def test_cascade_qscale_dtype_validation(self):
+        plan = design_cascade(100.0, 20, CORNER, 4)
+        with pytest.raises(ValueError, match="dtype"):
+            cascade_decimate(
+                np.zeros((4000, 4), np.float32), plan, 10, 8, "xla",
+                qscale=0.5,
+            )
+
+
 class TestStageEngines:
     def test_decision_matches_build_predicate(self):
         from tpudas.ops.fir import design_cascade, stage_engines

@@ -157,6 +157,28 @@ class TestShardedCascade:
         x = _signal(600, 4, 100.0)
         assert sharded_cascade_decimate(mesh, x, plan, 10, 8) is None
 
+    def test_quantized_bit_equal_to_single_device(self):
+        """Raw int16 windows shard undecoded (half the ICI halo bytes);
+        the result matches the single-device quantized cascade bit for
+        bit, which itself matches decode-then-cascade."""
+        from tpudas.ops.fir import cascade_decimate
+        from tpudas.parallel.pipeline import sharded_cascade_decimate
+
+        plan = self._plan()
+        mesh = make_mesh(8, time_shards=2)
+        rng = np.random.default_rng(7)
+        q = rng.integers(-3000, 3000, size=(12000, 12)).astype(np.int16)
+        s = 1e-3
+        phase, n_out = 200, 110
+        ref = np.asarray(
+            cascade_decimate(q, plan, phase, n_out, "xla", qscale=s)
+        )
+        out = sharded_cascade_decimate(
+            mesh, q, plan, phase, n_out, qscale=s
+        )
+        assert out is not None
+        assert np.array_equal(np.asarray(out), ref)
+
 
 class TestLFProcMesh:
     """The product engine runs mesh-sharded end to end: output files

@@ -314,18 +314,20 @@ def cascade_input_need(plan: CascadePlan, n_out: int) -> int:
 def _pallas_stage_ok(k: int, R: int, n_ch: int, n_frames: int) -> bool:
     """Pallas only for stages that are big enough to matter: small
     stages measure slower under the kernel (grid overheads dominate)
-    AND their grid rounding — the kernel's quantum is 512 output
-    frames (4 parallel 128-frame sub-blocks per step) — inflates
-    every upstream stage's output count through the chain layout.
-    Thresholds from the v5e measurements behind BENCH_r04: >= 2^24
-    elements touched and a full first grid step. Taps must also fit
-    the kernel's 128-frame sub-block; very long single-stage plans
+    AND their grid rounding — the kernel's quantum is ``_KB`` output
+    frames (``_P`` parallel ``_SB``-frame sub-blocks per step) —
+    inflates every upstream stage's output count through the chain
+    layout. Thresholds from the v5e measurements behind BENCH_r04:
+    >= 2^24 elements touched and a full first grid step. Taps must
+    also fit the kernel's sub-block; very long single-stage plans
     (possible via the public design API) take the XLA polyphase path
     instead of erroring."""
+    from tpudas.ops.pallas_fir import _KB, _SB
+
     return (
         k * R * n_ch >= (1 << 24)
-        and k >= 512
-        and n_frames <= 128
+        and k >= _KB
+        and n_frames <= _SB
     )
 
 
@@ -370,16 +372,34 @@ def stage_engines(
     return [e for e, _ in chain_layout(plan, n_out, n_ch, engine)[0]]
 
 
-def _apply_cascade_stages(x, blocked, n_out, use_pallas, interpret):
+def _check_quantized(x, qscale):
+    """Shared guard for every quantized-ingest entry point: ``qscale``
+    must accompany exactly an int16 payload."""
+    import jax.numpy as jnp
+
+    if qscale is not None and x.dtype != jnp.int16:
+        raise ValueError(f"qscale given but data dtype is {x.dtype}")
+
+
+def _apply_cascade_stages(x, blocked, n_out, use_pallas, interpret,
+                          qscale=None):
     """Traceable cascade body shared by the jit path and the shard_map
     (mesh) paths: x (T_local, C_local) -> (n_out, C_local).
 
     Per-stage engine/size decisions come from :func:`chain_layout` on
     the traced shape, so emitted sizes line up stage to stage (pad-free
-    when the input is pre-sized to the layout's ``rows``)."""
+    when the input is pre-sized to the layout's ``rows``).
+
+    ``qscale`` (a TRACED scalar — one compiled executable serves every
+    scale) marks a quantized int16 ingest window: the first stage
+    reads the raw int16 payload (half the HBM bytes) and dequantizes
+    inside its kernel.  On the XLA path that is a fused cast*scale —
+    bit-identical to decoding first.  On the Pallas path the kernel
+    casts raw in VMEM and, the FIR being linear, the scale multiplies
+    the stage's decimated (R-times smaller) output.
+    """
     import jax.numpy as jnp
 
-    x = x.astype(jnp.float32)
     engine = "pallas" if use_pallas else "xla"
     layout, _rows = _layout_for(
         tuple((int(R), int(hb.shape[0])) for R, hb in blocked),
@@ -387,13 +407,25 @@ def _apply_cascade_stages(x, blocked, n_out, use_pallas, interpret):
         int(x.shape[1]),
         engine,
     )
-    for (R, hb), (eng, k) in zip(blocked, layout):
+    first_pallas = layout[0][0] == "pallas" if layout else False
+    quantized = qscale is not None and x.dtype == jnp.int16
+    scale0 = None
+    if quantized:
+        if first_pallas:
+            scale0 = jnp.float32(qscale)  # applied to stage-0 output
+        else:
+            x = x.astype(jnp.float32) * jnp.float32(qscale)
+    else:
+        x = x.astype(jnp.float32)
+    for i, ((R, hb), (eng, k)) in enumerate(zip(blocked, layout)):
         if eng == "pallas":
             from tpudas.ops.pallas_fir import fir_decimate_pallas
 
             x = fir_decimate_pallas(x, hb, R, n_out=k, interpret=interpret)
         else:
             x = _polyphase_stage_xla(x, hb, R, k)
+        if i == 0 and scale0 is not None:
+            x = x * scale0
     return x
 
 
@@ -431,8 +463,11 @@ def _pallas_interpret() -> bool:
 
 @functools.lru_cache(maxsize=64)
 def _build_cascade_fn(plan: CascadePlan, n_out: int, engine: str, mesh=None,
-                      ch_axis="ch"):
-    """jit-compiled causal cascade: x (T, C) -> (n_out, C).
+                      ch_axis="ch", quantized=False):
+    """jit-compiled causal cascade: x (T, C) -> (n_out, C); with
+    ``quantized`` the signature is (x_int16, scale) and the scale is a
+    TRACED operand (the compile caches on the bool, not the value —
+    spools with differing quantization scales share one executable).
 
     With ``mesh``, the cascade runs under ``shard_map`` with channels
     split over the mesh's ``ch_axis`` — the zero-communication layout
@@ -447,18 +482,27 @@ def _build_cascade_fn(plan: CascadePlan, n_out: int, engine: str, mesh=None,
     use_pallas = engine == "pallas"
     interpret = _pallas_interpret() if use_pallas else False
 
-    def fn(x):
-        return _apply_cascade_stages(x, blocked, n_out, use_pallas, interpret)
+    if quantized:
+        def fn(x, scale):
+            return _apply_cascade_stages(
+                x, blocked, n_out, use_pallas, interpret, qscale=scale
+            )
+    else:
+        def fn(x):
+            return _apply_cascade_stages(
+                x, blocked, n_out, use_pallas, interpret
+            )
 
     if mesh is not None:
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
         spec = P(None, ch_axis)
+        in_specs = (spec, P()) if quantized else (spec,)
         body = shard_map(
             fn,
             mesh=mesh,
-            in_specs=(spec,),
+            in_specs=in_specs,
             out_specs=spec,
             check_vma=False,
         )
@@ -468,7 +512,7 @@ def _build_cascade_fn(plan: CascadePlan, n_out: int, engine: str, mesh=None,
 
 def cascade_decimate(
     x, plan: CascadePlan, phase: int, n_out: int, engine="auto",
-    mesh=None, ch_axis="ch",
+    mesh=None, ch_axis="ch", qscale=None,
 ):
     """Zero-phase filtered + decimated samples of ``x`` (T, C).
 
@@ -483,25 +527,39 @@ def cascade_decimate(
     With ``mesh``, channels are split over the mesh's ``ch_axis``
     (zero-communication sharding; C is zero-padded to a multiple of the
     axis size and trimmed after).
+
+    ``qscale`` accepts a raw int16 quantized window (tdas ingest fast
+    path): the H2D transfer and the first stage's HBM read stay int16
+    and dequantization happens inside the first kernel — equivalent to
+    ``cascade_decimate(x.astype(f32) * qscale, ...)``.  The scale is a
+    traced operand: windows with different scales share one compile.
     """
     import jax.numpy as jnp
 
     engine = resolve_cascade_engine(engine)
     x = jnp.asarray(x)
+    _check_quantized(x, qscale)
+    quantized = qscale is not None
     shift = int(phase) - plan.delay
     if shift >= 0:
         x2 = x[shift:]
     else:
         x2 = jnp.pad(x, ((-shift, 0), (0, 0)))
+    args = (x2, jnp.float32(qscale)) if quantized else (x2,)
     if mesh is None:
-        return _build_cascade_fn(plan, int(n_out), engine)(x2)
+        fn = _build_cascade_fn(
+            plan, int(n_out), engine, quantized=quantized
+        )
+        return fn(*args)
     nc = mesh.shape[ch_axis]
     C = x2.shape[1]
     pad_c = -C % nc
     if pad_c:
         x2 = jnp.pad(x2, ((0, 0), (0, pad_c)))
-    fn = _build_cascade_fn(plan, int(n_out), engine, mesh, ch_axis)
-    out = fn(x2)
+        args = (x2, *args[1:])
+    fn = _build_cascade_fn(plan, int(n_out), engine, mesh, ch_axis,
+                           quantized=quantized)
+    out = fn(*args)
     return out[:, :C] if pad_c else out
 
 

@@ -124,6 +124,11 @@ def _kernel_body(P, SB, CB, halo_rows, exact):
                 else halo_ref[:]
             )
             x = jnp.concatenate([mains[j][:], head], axis=0)
+            # int16 ingest: bare cast in VMEM after the (half-width)
+            # DMA — the quantization scale is the caller's (applied to
+            # the decimated output; the FIR is linear).  Exact under
+            # the 3x split too: a 16-bit integer is hi+lo bf16 exactly.
+            x = x.astype(jnp.float32)
             out_ref[j * SB : (j + 1) * SB] = dot(a_ref[:], x)
 
     return kernel
@@ -141,7 +146,8 @@ def _band_matrix(taps: tuple, R: int, SB: int, rows: int) -> np.ndarray:
 def fir_decimate_pallas(
     x, hb, R: int, n_out: int, interpret: bool = False, kb=_KB, cb=_CB
 ):
-    """Strided FIR: x (T, C) f32, hb (B, R) f32 -> (n_out, C) f32.
+    """Strided FIR: x (T, C) f32 or int16, hb (B, R) f32 -> (n_out, C)
+    f32.
 
     ``hb`` must be CONCRETE (host numpy or a settled device array, not
     a tracer): the banded tap matrix is built on the host.  ``x`` may
@@ -152,6 +158,13 @@ def fir_decimate_pallas(
     not multiples of the lane tile get whole-block zero padding.
     ``kb`` is the grid quantum in output frames (P parallel sub-blocks
     of min(kb, 128) frames each); ``cb`` the channel block.
+
+    int16 ``x`` (the tdas quantized-ingest payload) is cast to f32 in
+    VMEM after the half-width DMA and filtered RAW — the caller owns
+    the quantization scale and, the FIR being linear, applies it to
+    this stage's (decimated, so R-times smaller) output.  Keeping the
+    scale out of the kernel keeps it a traced value: one compiled
+    executable serves every scale.
     """
     B = int(hb.shape[0])
     T, C = x.shape

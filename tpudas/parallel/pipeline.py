@@ -106,7 +106,7 @@ def sharded_lowpass_decimate(
 
 @functools.lru_cache(maxsize=64)
 def _build_sharded_cascade_fn(
-    plan, n_loc, halo, engine, mesh, time_axis, ch_axis
+    plan, n_loc, halo, engine, mesh, time_axis, ch_axis, quantized=False
 ):
     """jit-compiled shard_map cascade: (nt*t_local, C) -> (nt*n_loc, C).
 
@@ -130,21 +130,30 @@ def _build_sharded_cascade_fn(
     use_pallas = engine == "pallas"
     interpret = _pallas_interpret() if use_pallas else False
 
+    in_specs = (
+        (P(time_axis, ch_axis), P())
+        if quantized
+        else (P(time_axis, ch_axis),)
+    )
+
     @functools.partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(time_axis, ch_axis),),
+        in_specs=in_specs,
         out_specs=P(time_axis, ch_axis),
         check_vma=False,
     )
-    def step(block):
+    def step(block, *maybe_scale):
         # causal consumer: only the RIGHT (look-ahead) halo is needed,
-        # so the exchange is one-sided — half the ICI traffic
+        # so the exchange is one-sided — half the ICI traffic (and a
+        # quantized int16 window keeps its halved payload across the
+        # ring too: dequantization happens inside the first stage)
         padded = exchange_halo_time(
             block, halo, axis_name=time_axis, n_shards=nt, left=False
         )
         return _apply_cascade_stages(
-            padded, blocked, n_loc, use_pallas, interpret
+            padded, blocked, n_loc, use_pallas, interpret,
+            qscale=maybe_scale[0] if quantized else None,
         )
 
     return jax.jit(step)
@@ -189,7 +198,7 @@ def sharded_cascade_layout(mesh, plan, phase, n_out, T,
 
 def sharded_cascade_decimate(
     mesh, x, plan, phase, n_out, engine="auto",
-    time_axis="time", ch_axis="ch",
+    time_axis="time", ch_axis="ch", qscale=None,
 ):
     """Mesh-parallel :func:`tpudas.ops.fir.cascade_decimate`: the time
     axis is sharded over ``time_axis`` (one-sided halo exchange over
@@ -204,7 +213,7 @@ def sharded_cascade_decimate(
     """
     import jax.numpy as jnp
 
-    from tpudas.ops.fir import resolve_cascade_engine
+    from tpudas.ops.fir import _check_quantized, resolve_cascade_engine
 
     nt = mesh.shape[time_axis]
     nc = mesh.shape[ch_axis]
@@ -218,7 +227,11 @@ def sharded_cascade_decimate(
     n_loc, t_local, halo = layout
     n_out = int(n_out)
     engine = resolve_cascade_engine(engine)
-    x = jnp.asarray(x, jnp.float32)
+    if qscale is not None:
+        x = jnp.asarray(x)  # raw int16: dequantized inside stage 0
+        _check_quantized(x, qscale)
+    else:
+        x = jnp.asarray(x, jnp.float32)
     C = int(x.shape[1])
     shift = int(phase) - plan.delay
     if shift >= 0:
@@ -233,7 +246,11 @@ def sharded_cascade_decimate(
     if pad_c:
         x2 = jnp.pad(x2, ((0, 0), (0, pad_c)))
     fn = _build_sharded_cascade_fn(
-        plan, n_loc, halo, engine, mesh, time_axis, ch_axis
+        plan, n_loc, halo, engine, mesh, time_axis, ch_axis,
+        quantized=qscale is not None,
     )
-    out = fn(x2)
+    if qscale is not None:
+        out = fn(x2, jnp.float32(qscale))
+    else:
+        out = fn(x2)
     return out[:n_out, :C]

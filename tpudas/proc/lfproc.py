@@ -106,33 +106,38 @@ def schedule_windows(n_grid: int, patch_size: int, buff_size: int):
     return windows
 
 
-@jax.jit
-def _decode_i16_kernel(x, scale):
-    """Dequantize int16 samples on DEVICE: the host transfers half the
-    bytes and the cast*scale runs at HBM speed. Bit-identical to the
-    host reader's ``raw.astype(float32) * float32(scale)``."""
-    return x.astype(jnp.float32) * scale
-
-
 @functools.partial(jax.jit, static_argnames=("nfft", "order"))
-def _lowpass_resample_kernel(data, d_sec, corner, idx, w, nfft, order):
+def _lowpass_resample_kernel(data, d_sec, corner, idx, w, nfft, order,
+                             scale=None):
     """Fused window kernel: zero-phase low-pass + gather-lerp decimate.
 
-    data: (T, C) f32; idx/w: (K,) gather plan into the filtered rows.
+    data: (T, C) f32 — or raw int16 with ``scale``, in which case the
+    dequantizing cast*scale is the kernel's first traced op so XLA
+    fuses it into the FFT input read (the quantized tdas ingest path:
+    half the H2D bytes, no materialized f32 intermediate).
+    idx/w: (K,) gather plan into the filtered rows.
     """
     from tpudas.ops.filter import fft_lowpass_response
 
+    if scale is not None:
+        data = data.astype(jnp.float32) * scale
     spec = jnp.fft.rfft(data, n=nfft, axis=0)
     resp = fft_lowpass_response(nfft, d_sec, corner, order)
     filt = jnp.fft.irfft(spec * resp[:, None], n=nfft, axis=0)
     lo = jnp.take(filt, idx, axis=0)
     hi = jnp.take(filt, idx + 1, axis=0)
-    return (lo + (hi - lo) * w[:, None]).astype(data.dtype)
+    return (lo + (hi - lo) * w[:, None]).astype(jnp.float32)
 
 
-def lowpass_resample(data, d_sec, corner, idx, w, order=4):
+def lowpass_resample(data, d_sec, corner, idx, w, order=4, qscale=None):
     """Jittable fused pipeline (also the graft-entry/bench step)."""
-    data = jnp.asarray(data, jnp.float32)
+    from tpudas.ops.fir import _check_quantized
+
+    if qscale is not None:
+        data = jnp.asarray(data)
+        _check_quantized(data, qscale)
+    else:
+        data = jnp.asarray(data, jnp.float32)
     nfft = next_tpu_fft_len(int(data.shape[0]))
     return _lowpass_resample_kernel(
         data,
@@ -142,6 +147,7 @@ def lowpass_resample(data, d_sec, corner, idx, w, order=4):
         jnp.asarray(w, jnp.float32),
         nfft,
         int(order),
+        scale=None if qscale is None else jnp.float32(qscale),
     )
 
 
@@ -611,25 +617,28 @@ class LFProc:
         )
         qscale = window_patch.attrs.get("data_scale")
         t_dev0 = time.perf_counter()
-        if host.dtype == np.int16 and qscale is not None:
+        quantized = host.dtype == np.int16 and qscale is not None
+        if quantized:
             # quantized window (tdas int16 fast path): ship the raw
-            # int16 across H2D and decode on device
-            host32 = _decode_i16_kernel(
-                jax.device_put(host), jnp.float32(qscale)
-            )
+            # int16 across H2D and dequantize INSIDE the first device
+            # kernel — half the transfer bytes AND half the first
+            # stage's HBM read, with no intermediate f32 round trip
+            host32 = host
+            qs = float(qscale)
         else:
             host32 = host.astype(np.float32, copy=False)
+            qs = None
         if align is not None:
             out = None
             if time_layout is not None:
                 from tpudas.parallel.pipeline import sharded_cascade_decimate
 
                 out = sharded_cascade_decimate(
-                    mesh, host32, plan, phase, n_out
+                    mesh, host32, plan, phase, n_out, qscale=qs
                 )
             if out is None:
                 out = cascade_decimate(
-                    host32, plan, phase, n_out, mesh=mesh
+                    host32, plan, phase, n_out, mesh=mesh, qscale=qs
                 )
         else:
             idx, w = interp_indices_weights(taxis, target_times)
@@ -655,7 +664,7 @@ class LFProc:
                     data, NamedSharding(mesh, P(None, "ch"))
                 )
             out = lowpass_resample(
-                data, d_sec, corner, idx, w, order=order
+                data, d_sec, corner, idx, w, order=order, qscale=qs
             )
             if pad_c:
                 out = out[:, :n_ch]
