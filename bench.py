@@ -83,8 +83,14 @@ JSON line — a bounded second child running the full product path on a
 local tdas spool — so every round artifact records the pipeline
 real-time factor beside the resident-kernel number.
 
+A kernel-mode run also records (TPU defaults) an ``int16`` sub-object:
+the same cascade fed RAW int16 windows with the dequantize fused into
+the first stage (tpudas quantized tdas ingest) — half the HBM read
+bytes of the f32 headline, the realistic edge-interrogator payload.
+
 Env knobs: BENCH_T, BENCH_C, BENCH_ITERS, BENCH_ENGINE,
 BENCH_PALLAS=0/1, BENCH_INCLUDE_H2D=0/1, BENCH_COMPARE=0/1,
+BENCH_QUANT=0/1 (int16-payload kernel measurement),
 BENCH_PROFILE=0/1 (per-stage cascade breakdown),
 BENCH_MODE=kernel/e2e, BENCH_E2E_SEC, BENCH_E2E_FS, BENCH_E2E_TIMEOUT,
 BENCH_BUDGET (total parent wall budget, s), BENCH_PROBE_TIMEOUT,
@@ -294,7 +300,7 @@ def _build_fft_step(T, C, fs, dt_out, order):
 
 
 def _build_cascade_step(T, C, fs, dt_out, order, use_pallas, mesh=None,
-                        time_shards=1):
+                        time_shards=1, quantized=False):
     """(kernel, analytic flops/window, T_used, report).
 
     ``T_used`` is the pad-free window length closest to T (never below
@@ -365,6 +371,16 @@ def _build_cascade_step(T, C, fs, dt_out, order, use_pallas, mesh=None,
             return cascade_decimate(
                 data, plan, plan.delay, n_out, engine, mesh=mesh
             )
+    elif quantized:
+        # raw int16 windows (the realistic interrogator payload): the
+        # scale is a traced operand of the same compiled cascade
+        import jax.numpy as jnp
+
+        fnq = _build_cascade_fn(plan, n_out, engine, quantized=True)
+
+        def fn(data, _fnq=fnq, _s=jnp.float32(1e-3)):
+            return _fnq(data, _s)
+
     else:
         fn = _build_cascade_fn(plan, n_out, engine)
 
@@ -387,7 +403,7 @@ def _build_cascade_step(T, C, fs, dt_out, order, use_pallas, mesh=None,
     return (lambda data: fn(data)), flops, T_used, report
 
 
-def _measure(kernel, T, C, iters, include_h2d):
+def _measure(kernel, T, C, iters, include_h2d, dtype="float32"):
     """Wall time for ``iters`` windows through ``kernel``.
 
     Resident-kernel mode runs the ENTIRE measured loop on device as one
@@ -416,7 +432,8 @@ def _measure(kernel, T, C, iters, include_h2d):
         return elapsed, iters, None
 
     # NW resident windows within ~9 GB of HBM; rep covers iters
-    nw = max(1, min(6, int(9e9 // (T * C * 4))))
+    es = 2 if dtype == "int16" else 4
+    nw = max(1, min(6, int(9e9 // (T * C * es))))
     if nw == 1:
         # a single resident window makes the scan body loop-invariant —
         # XLA may hoist it and the number inflates past HBM peak. Never
@@ -429,9 +446,16 @@ def _measure(kernel, T, C, iters, include_h2d):
             flush=True,
         )
     rep = max(1, -(-iters // nw))
-    gen = jax.jit(
-        lambda key: jax.random.normal(key, (nw, T, C), jnp.float32)
-    )
+    if dtype == "int16":
+        gen = jax.jit(
+            lambda key: jax.random.randint(
+                key, (nw, T, C), -3000, 3000, jnp.int16
+            )
+        )
+    else:
+        gen = jax.jit(
+            lambda key: jax.random.normal(key, (nw, T, C), jnp.float32)
+        )
     stack = gen(jax.random.PRNGKey(0))
     jax.block_until_ready(stack)
 
@@ -704,6 +728,51 @@ def _child() -> None:
         result["stage_times_ms"] = stage_ms
         print(f"[bench] stage profile: {stage_ms}", file=sys.stderr,
               flush=True)
+
+    # Quantized-payload kernel (BENCH_QUANT=1, TPU default): the same
+    # cascade fed raw int16 windows with an in-kernel dequantize — the
+    # realistic edge-interrogator payload, at half the HBM read bytes.
+    quant = (
+        os.environ.get("BENCH_QUANT", "1" if on_tpu else "0") == "1"
+        and engine == "cascade"
+        and mesh is None
+        and not include_h2d
+    )
+    if quant:
+        left = remaining - (time.monotonic() - child_start)
+        if left <= 120:
+            result["int16_skipped"] = f"budget: {left:.0f}s left"
+        else:
+            try:
+                qk, _, t_q, q_report = _build_cascade_step(
+                    T, C, fs, dt_out, order, use_pallas, quantized=True
+                )
+                dt_q, n_q, _ = _measure(
+                    qk, t_q, C, max(4, iters // 4), False, dtype="int16"
+                )
+                q_val = t_q * C * n_q / dt_q
+                emitted_q = sum(k for _, k in q_report["stages"])
+                emitted_q *= q_report["emitted_k_factor"]
+                bytes_q = C * (2.0 * t_q + 8.0 * emitted_q)
+                sub = {
+                    "value": round(q_val, 1),
+                    "vs_baseline": round(q_val / 1e8, 4),
+                    "realtime_factor": round(t_q * n_q / fs / dt_q, 2),
+                    "hbm_gbps": round(bytes_q * n_q / dt_q / 1e9, 1),
+                }
+                peak_hbm = _PEAK_HBM.get(gen)
+                if peak_hbm and backend != "cpu":
+                    sub["hbm_frac"] = round(
+                        bytes_q * n_q / dt_q / peak_hbm, 4
+                    )
+                result["int16"] = sub
+                print(
+                    f"[bench] int16 kernel: {q_val:.1f}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+            except Exception as exc:
+                result["int16"] = {"error": str(exc)[:200]}
 
     # Optional engine shoot-out (small iters) so 'auto' is data-driven.
     # Gate on the time ACTUALLY left (remaining was frozen at child

@@ -87,6 +87,27 @@ class TestCompareVisibility:
         assert result["value"] > 0
         assert result["metric"] == "channel_samples_per_sec"
 
+    def test_quantized_kernel_measured(self, monkeypatch, capsys):
+        """BENCH_QUANT=1 records the raw-int16-payload kernel rate
+        beside the f32 headline (the realistic interrogator payload)."""
+        result = _run_child(
+            monkeypatch, capsys, BENCH_QUANT="1", BENCH_REMAINING="100000"
+        )
+        sub = result["int16"]
+        assert sub["value"] > 0
+        assert sub["realtime_factor"] > 0
+        assert "hbm_gbps" in sub
+
+    def test_quantized_kernel_budget_skip_recorded(
+        self, monkeypatch, capsys
+    ):
+        result = _run_child(
+            monkeypatch, capsys, BENCH_QUANT="1", BENCH_COMPARE="0",
+            BENCH_REMAINING="0",
+        )
+        assert "int16" not in result
+        assert "budget" in result["int16_skipped"]
+
 
 class TestE2EChild:
     def test_int16_payload_e2e(self, monkeypatch, capsys):
