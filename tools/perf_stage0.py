@@ -1,0 +1,142 @@
+"""Stage-0 kernel harness: measure the product Pallas FIR on the chip.
+
+The flagship cascade's first stage (R=8 guard FIR at full rate)
+carries ~85% of the window's HBM traffic, so it is the tuning target.
+This harness measures, under bench.py's resident scan methodology:
+
+  read-ceiling    jnp.sum over the resident window — the practical
+                  HBM read bandwidth visible to this harness (~500
+                  GB/s of the v5e's 819 on the 2026-07-30 session)
+  pallas stage0   the product kernel (tpudas.ops.pallas_fir) across
+                  (kb, cb) grid geometries, f32 and raw int16 input
+  xla stage0      the XLA polyphase formulation for reference
+
+History (documented in PERF.md §5): the v1 VPU kernel measured
+compute-bound at ~174 GB/s; single-stream auto-pipelined DMA capped at
+~185 GB/s regardless of block geometry (probe_pipeline.py), which
+motivated the v2 MXU banded-matmul kernel with P parallel input
+streams.
+
+Run: python tools/perf_stage0.py   (on the TPU; each config compiles)
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+from tpudas.ops.fir import _block_taps, design_cascade, _polyphase_stage_xla
+from tpudas.ops.pallas_fir import fir_decimate_pallas, stage_input_rows
+
+C = 2048
+ITERS = 96
+
+
+def measure(fn, T, iters=ITERS, dtype="float32"):
+    """bench.py's resident scan loop, standalone."""
+    es = 2 if dtype == "int16" else 4
+    nw = max(1, min(6, int(9e9 // (T * C * es))))
+    rep = max(1, -(-iters // nw))
+    if dtype == "int16":
+        gen = jax.jit(
+            lambda key: jax.random.randint(
+                key, (nw, T, C), -3000, 3000, jnp.int16
+            )
+        )
+    else:
+        gen = jax.jit(
+            lambda key: jax.random.normal(key, (nw, T, C), jnp.float32)
+        )
+    stack = gen(jax.random.PRNGKey(0))
+    jax.block_until_ready(stack)
+
+    @jax.jit
+    def run(st):
+        def body(tot, w):
+            return tot + jnp.sum(jnp.abs(fn(w)).astype(jnp.float32)), None
+
+        def outer(tot, _):
+            t, _ = jax.lax.scan(body, tot, st)
+            return t, None
+
+        tot, _ = jax.lax.scan(
+            outer, jnp.zeros((), jnp.float32), None, length=rep
+        )
+        return tot
+
+    assert np.isfinite(float(run(stack)))
+    best = 1e30
+    for _ in range(2):
+        t0 = time.perf_counter()
+        assert np.isfinite(float(run(stack)))
+        best = min(best, time.perf_counter() - t0)
+    return best / (nw * rep)
+
+
+def report(name, T, dt, in_bytes=4.0, extra_bytes_per_in=0.0):
+    gsps = T * C / dt / 1e9
+    gbps = T * C * (in_bytes + extra_bytes_per_in) / dt / 1e9
+    print(
+        f"{name:34s} {dt * 1e3:8.3f} ms/win  {gsps:7.2f} G ch-samp/s  "
+        f"{gbps:6.1f} GB/s ({gbps / 819 * 100:4.1f}% peak)",
+        flush=True,
+    )
+
+
+def main():
+    print(f"backend={jax.default_backend()}", flush=True)
+    plan = design_cascade(1000.0, 1000, 0.45, 4)
+    R, h0 = plan.stages[0]
+    hb = _block_taps(np.asarray(h0), R)
+    B = int(hb.shape[0])
+    print(f"stage0: R={R} taps={len(h0)} B={B}", flush=True)
+
+    T0 = 129088
+    dt = measure(lambda x: jnp.sum(x, axis=0), T0)
+    report("read-ceiling (sum)", T0, dt)
+
+    # product kernel: (kb, cb) sweep; kb=512 is the product default
+    # (P=4 parallel 128-frame sub-blocks per grid step)
+    for kb, cb in [(512, 128), (512, 256), (1024, 128), (256, 128)]:
+        n_out = -(-16000 // kb) * kb
+        T = stage_input_rows(B, R, n_out, kb)
+        try:
+            dt = measure(
+                lambda x, kb=kb, cb=cb, n_out=n_out: fir_decimate_pallas(
+                    x, hb, R, n_out=n_out, kb=kb, cb=cb
+                ),
+                T,
+            )
+            report(f"pallas f32 kb={kb} cb={cb}", T, dt, 4.0, 2 * 4 / 8)
+        except Exception as exc:
+            print(f"pallas kb={kb} cb={cb}: {str(exc)[:120]}", flush=True)
+
+    # raw int16 payload (the quantized tdas ingest): half the read
+    n_out = 16384
+    T = stage_input_rows(B, R, n_out, 512)
+    try:
+        dt = measure(
+            lambda x: fir_decimate_pallas(x, hb, R, n_out=n_out),
+            T,
+            dtype="int16",
+        )
+        report("pallas int16 kb=512 cb=128", T, dt, 2.0, 2 * 4 / 8)
+    except Exception as exc:
+        print(f"pallas int16: {str(exc)[:120]}", flush=True)
+
+    # XLA polyphase reference
+    n_out = 16128
+    T = (n_out + B) * R
+    dt = measure(lambda x: _polyphase_stage_xla(x, hb, R, n_out), T)
+    report("xla polyphase", T, dt, 4.0, 2 * 4 / 8)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,124 @@
+"""Probe: Pallas pipeline read bandwidth vs block geometry.
+
+The main-block DMA for a (ROWS, CB) block of a (T, 2048) f32 array
+moves ROWS chunks of CB*4 contiguous bytes (row stride 8 KB).  Measures
+how achieved HBM read bandwidth depends on chunk width and grid order.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+C = 2048
+T = 129024  # 16128 * 8
+
+
+def measure(fn, T, iters=96):
+    nw = max(1, min(6, int(9e9 // (T * C * 4))))
+    rep = max(1, -(-iters // nw))
+    stack = jax.jit(
+        lambda key: jax.random.normal(key, (nw, T, C), jnp.float32)
+    )(jax.random.PRNGKey(0))
+    jax.block_until_ready(stack)
+
+    @jax.jit
+    def run(st):
+        def body(tot, w):
+            return tot + jnp.sum(jnp.abs(fn(w))), None
+
+        def outer(tot, _):
+            t, _ = jax.lax.scan(body, tot, st)
+            return t, None
+
+        tot, _ = jax.lax.scan(
+            outer, jnp.zeros((), jnp.float32), None, length=rep
+        )
+        return tot
+
+    assert np.isfinite(float(run(stack)))
+    best = 1e30
+    for _ in range(2):
+        t0 = time.perf_counter()
+        assert np.isfinite(float(run(stack)))
+        best = min(best, time.perf_counter() - t0)
+    return best / (nw * rep)
+
+
+def copy_kernel(rows, cb, k_fastest=False):
+    """Read (rows, cb) blocks, emit head (rows//8, cb) rows."""
+    nk = T // rows
+    nc = C // cb
+    out_rows = rows // 8
+
+    def body(xm_ref, out_ref):
+        out_ref[:] = xm_ref[:out_rows]
+
+    if k_fastest:
+        grid = (nc, nk)
+        in_map = lambda c, k: (k, c)
+        out_map = lambda c, k: (k, c)
+    else:
+        grid = (nk, nc)
+        in_map = lambda k, c: (k, c)
+        out_map = lambda k, c: (k, c)
+
+    def fn(x):
+        return pl.pallas_call(
+            body,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(
+                    (rows, cb), in_map, memory_space=pltpu.VMEM
+                )
+            ],
+            out_specs=pl.BlockSpec(
+                (out_rows, cb), out_map, memory_space=pltpu.VMEM
+            ),
+            out_shape=jax.ShapeDtypeStruct(
+                (T // 8, C), jnp.float32
+            ),
+        )(x)
+
+    return fn
+
+
+def main():
+    for rows, cb, kf in [
+        (1024, 128, False),
+        (1024, 512, False),
+        (1024, 1024, False),
+        (512, 2048, False),
+        (256, 2048, False),
+        (1024, 2048, False),
+        (2048, 2048, False),
+        (1024, 128, True),
+        (1024, 512, True),
+    ]:
+        try:
+            dt = measure(copy_kernel(rows, cb, kf), T)
+            gbps = T * C * 4 / dt / 1e9
+            print(
+                f"rows={rows:5d} cb={cb:5d} kfast={int(kf)}  "
+                f"{dt * 1e3:7.3f} ms  {gbps:6.1f} GB/s "
+                f"({gbps / 819 * 100:4.1f}%)",
+                flush=True,
+            )
+        except Exception as exc:
+            print(
+                f"rows={rows} cb={cb} kfast={int(kf)}: {str(exc)[:120]}",
+                flush=True,
+            )
+
+
+if __name__ == "__main__":
+    main()
