@@ -108,6 +108,32 @@ class TestCompareVisibility:
         assert "int16" not in result
         assert "budget" in result["int16_skipped"]
 
+    def test_pallas_failure_falls_back_to_xla(self, monkeypatch, capsys):
+        """A Mosaic/compile failure of the fast path must not cost the
+        round's headline: the child re-measures on cascade-xla and
+        records the error."""
+        import tpudas.ops.fir as fir_mod
+        import tpudas.ops.pallas_fir as pf_mod
+
+        def boom(*a, **k):
+            raise RuntimeError("mosaic compile failure (synthetic)")
+
+        fir_mod._layout_for.cache_clear()
+        fir_mod._build_cascade_fn.cache_clear()
+        monkeypatch.setattr(fir_mod, "_pallas_stage_ok", lambda *a: True)
+        monkeypatch.setattr(pf_mod, "fir_decimate_pallas", boom)
+        try:
+            result = _run_child(
+                monkeypatch, capsys, BENCH_PALLAS="1", BENCH_COMPARE="0",
+                BENCH_QUANT="0",
+            )
+        finally:
+            fir_mod._layout_for.cache_clear()
+            fir_mod._build_cascade_fn.cache_clear()
+        assert result["value"] > 0
+        assert result["engine"] == "cascade"
+        assert "mosaic compile failure" in result["pallas_error"]
+
 
 class TestE2EChild:
     def test_int16_payload_e2e(self, monkeypatch, capsys):

@@ -319,6 +319,62 @@ class TestStageEngines:
             assert sum(lfp.engine_counts.values()) == 4
 
 
+class TestPallasFallback:
+    def test_lfproc_survives_pallas_compile_failure(
+        self, tmp_path, monkeypatch, capsys
+    ):
+        """A Mosaic/compile failure of the Pallas fast path must not
+        kill the run: LFProc permanently falls back to the XLA cascade
+        (same numerics) and records the ground truth."""
+        import tpudas.ops.fir as fir_mod
+        import tpudas.ops.pallas_fir as pf_mod
+        from tpudas import spool
+        from tpudas.proc.lfproc import LFProc
+        from tpudas.testing import make_synthetic_spool
+
+        d = tmp_path / "raw"
+        make_synthetic_spool(
+            d, n_files=4, file_duration=30.0, fs=100.0, n_ch=6, noise=0.01
+        )
+
+        def boom(*a, **k):
+            raise RuntimeError("mosaic compile failure (synthetic)")
+
+        fir_mod._layout_for.cache_clear()
+        fir_mod._build_cascade_fn.cache_clear()
+        monkeypatch.setattr(
+            fir_mod, "resolve_cascade_engine",
+            lambda e="auto": "pallas" if e == "auto" else e,
+        )
+        monkeypatch.setattr(
+            fir_mod, "_pallas_stage_ok", lambda *a: True
+        )
+        monkeypatch.setattr(pf_mod, "fir_decimate_pallas", boom)
+        try:
+            lfp = LFProc(spool(str(d)).sort("time").update())
+            lfp.update_processing_parameter(
+                output_sample_interval=1.0,
+                process_patch_size=60,
+                edge_buff_size=10,
+            )
+            out = tmp_path / "out"
+            lfp.set_output_folder(str(out), delete_existing=True)
+            lfp.process_time_range(
+                np.datetime64("2023-03-22T00:00:00"),
+                np.datetime64("2023-03-22T00:02:00"),
+            )
+        finally:
+            fir_mod._layout_for.cache_clear()
+            fir_mod._build_cascade_fn.cache_clear()
+        assert not lfp._pallas_ok
+        assert lfp.engine_counts["cascade-pallas"] == 0
+        assert lfp.engine_counts["cascade-xla"] == sum(
+            lfp.engine_counts.values()
+        )
+        assert len(list(out.iterdir())) > 0
+        assert "falling back to the XLA" in capsys.readouterr().out
+
+
 class TestLFProcEngines:
     def test_cascade_equals_fft_engine(self, tmp_path):
         """Full chunked runs with engine='fft' vs engine='cascade' agree

@@ -11,7 +11,7 @@ This harness measures, under bench.py's resident scan methodology:
                   (kb, cb) grid geometries, f32 and raw int16 input
   xla stage0      the XLA polyphase formulation for reference
 
-History (documented in PERF.md §5): the v1 VPU kernel measured
+History (documented in PERF.md §4): the v1 VPU kernel measured
 compute-bound at ~174 GB/s; single-stream auto-pipelined DMA capped at
 ~185 GB/s regardless of block geometry (probe_pipeline.py), which
 motivated the v2 MXU banded-matmul kernel with P parallel input
@@ -22,62 +22,26 @@ Run: python tools/perf_stage0.py   (on the TPU; each config compiles)
 
 from __future__ import annotations
 
+import os
 import sys
-import time
 
 import numpy as np
 
 sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import jax
 import jax.numpy as jnp
 
+from scan_harness import measure as _measure
 from tpudas.ops.fir import _block_taps, design_cascade, _polyphase_stage_xla
 from tpudas.ops.pallas_fir import fir_decimate_pallas, stage_input_rows
 
 C = 2048
-ITERS = 96
 
 
-def measure(fn, T, iters=ITERS, dtype="float32"):
-    """bench.py's resident scan loop, standalone."""
-    es = 2 if dtype == "int16" else 4
-    nw = max(1, min(6, int(9e9 // (T * C * es))))
-    rep = max(1, -(-iters // nw))
-    if dtype == "int16":
-        gen = jax.jit(
-            lambda key: jax.random.randint(
-                key, (nw, T, C), -3000, 3000, jnp.int16
-            )
-        )
-    else:
-        gen = jax.jit(
-            lambda key: jax.random.normal(key, (nw, T, C), jnp.float32)
-        )
-    stack = gen(jax.random.PRNGKey(0))
-    jax.block_until_ready(stack)
-
-    @jax.jit
-    def run(st):
-        def body(tot, w):
-            return tot + jnp.sum(jnp.abs(fn(w)).astype(jnp.float32)), None
-
-        def outer(tot, _):
-            t, _ = jax.lax.scan(body, tot, st)
-            return t, None
-
-        tot, _ = jax.lax.scan(
-            outer, jnp.zeros((), jnp.float32), None, length=rep
-        )
-        return tot
-
-    assert np.isfinite(float(run(stack)))
-    best = 1e30
-    for _ in range(2):
-        t0 = time.perf_counter()
-        assert np.isfinite(float(run(stack)))
-        best = min(best, time.perf_counter() - t0)
-    return best / (nw * rep)
+def measure(fn, T, iters=96, dtype="float32"):
+    return _measure(fn, T, C, iters, dtype)
 
 
 def report(name, T, dt, in_bytes=4.0, extra_bytes_per_in=0.0):

@@ -23,35 +23,14 @@ C = 2048
 T = 129024  # 16128 * 8
 
 
+import os as _os
+import sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+from scan_harness import measure as _measure
+
+
 def measure(fn, T, iters=96):
-    nw = max(1, min(6, int(9e9 // (T * C * 4))))
-    rep = max(1, -(-iters // nw))
-    stack = jax.jit(
-        lambda key: jax.random.normal(key, (nw, T, C), jnp.float32)
-    )(jax.random.PRNGKey(0))
-    jax.block_until_ready(stack)
-
-    @jax.jit
-    def run(st):
-        def body(tot, w):
-            return tot + jnp.sum(jnp.abs(fn(w))), None
-
-        def outer(tot, _):
-            t, _ = jax.lax.scan(body, tot, st)
-            return t, None
-
-        tot, _ = jax.lax.scan(
-            outer, jnp.zeros((), jnp.float32), None, length=rep
-        )
-        return tot
-
-    assert np.isfinite(float(run(stack)))
-    best = 1e30
-    for _ in range(2):
-        t0 = time.perf_counter()
-        assert np.isfinite(float(run(stack)))
-        best = min(best, time.perf_counter() - t0)
-    return best / (nw * rep)
+    return _measure(fn, T, C, iters)
 
 
 def copy_kernel(rows, cb, k_fastest=False):

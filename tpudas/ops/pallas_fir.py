@@ -10,7 +10,7 @@ positions — the op the reference executes as full-rate ``sosfiltfilt``
 + decimating ``interpolate`` (lf_das.py:223-225) and XLA executes as
 B shifted matmuls with B full HBM passes.
 
-Design (v2, informed by on-chip measurement — see PERF.md §5):
+Design (v2, informed by on-chip measurement — see PERF.md §4):
 
 - **MXU banded matmul, not VPU shifted adds.**  For an SB-frame output
   sub-block the FIR is one dot ``Y = A @ X`` with

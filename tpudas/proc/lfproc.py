@@ -178,6 +178,13 @@ class LFProc:
         # the prefetch thread's window read, device = kernel dispatch
         # through host-side result sync, write = HDF5 output
         self.timings = {"assemble_s": 0.0, "device_s": 0.0, "write_s": 0.0}
+        # flips False permanently if the Pallas fast path fails to
+        # compile on this backend (engine falls back to the XLA
+        # cascade — same numerics; see _process_window).  _pallas_proven
+        # latches True once a pallas window has executed, restricting
+        # the fallback to first-use (compile-time) failures.
+        self._pallas_ok = True
+        self._pallas_proven = False
 
     # configuration ----------------------------------------------------
     def _default_process_parameters(self):
@@ -575,6 +582,10 @@ class LFProc:
                     align = None  # auto: fall back to the FFT engine
         mesh = self._mesh
         n_out = int(target_times.size)
+        # engine request honouring a previous in-process Pallas failure
+        # (self._pallas_ok): once the fast path has compile-failed on
+        # this backend it stays off for the rest of the run
+        eng_req = "auto" if self._pallas_ok else "xla"
         # which execution layout will this window take? decided up
         # front so the engine observability below reports exactly what
         # each device traces: under a mesh the Pallas size threshold
@@ -591,9 +602,11 @@ class LFProc:
             time_layout = sharded_cascade_layout(
                 mesh, plan, phase, n_out, int(host.shape[0]),
                 n_ch_local=-(-int(host.shape[1]) // mesh.shape["ch"]),
+                engine=eng_req,
             )
-        # observability: which engine actually ran this window (config
-        # says "auto"/"cascade"; this count/event is the ground truth)
+        # which engine will this window run under? (config says
+        # "auto"/"cascade"; the count/event emitted AFTER execution is
+        # the ground truth, surviving the Pallas fallback below)
         n_ch_decide = int(host.shape[1])
         if mesh is not None:
             n_ch_decide = -(-n_ch_decide // mesh.shape["ch"])
@@ -601,20 +614,12 @@ class LFProc:
             from tpudas.ops.fir import stage_engines
 
             n_out_decide = time_layout[0] if time_layout else n_out
-            stages = stage_engines(plan, n_out_decide, n_ch_decide)
+            stages = stage_engines(plan, n_out_decide, n_ch_decide, eng_req)
             ran = (
                 "cascade-pallas" if "pallas" in stages else "cascade-xla"
             )
         else:
             ran = "fft"
-        self.engine_counts[ran] += 1
-        log_event(
-            "window_engine",
-            engine=ran,
-            rows=int(host.shape[0]),
-            emitted=n_out,
-            mesh=None if mesh is None else dict(mesh.shape),
-        )
         qscale = window_patch.attrs.get("data_scale")
         t_dev0 = time.perf_counter()
         quantized = host.dtype == np.int16 and qscale is not None
@@ -629,17 +634,44 @@ class LFProc:
             host32 = host.astype(np.float32, copy=False)
             qs = None
         if align is not None:
-            out = None
-            if time_layout is not None:
-                from tpudas.parallel.pipeline import sharded_cascade_decimate
+            def _run_cascade(eng):
+                if time_layout is not None:
+                    from tpudas.parallel.pipeline import (
+                        sharded_cascade_decimate,
+                    )
 
-                out = sharded_cascade_decimate(
-                    mesh, host32, plan, phase, n_out, qscale=qs
+                    o = sharded_cascade_decimate(
+                        mesh, host32, plan, phase, n_out, engine=eng,
+                        qscale=qs,
+                    )
+                    if o is not None:
+                        return o
+                return cascade_decimate(
+                    host32, plan, phase, n_out, eng, mesh=mesh, qscale=qs
                 )
-            if out is None:
-                out = cascade_decimate(
-                    host32, plan, phase, n_out, mesh=mesh, qscale=qs
+
+            try:
+                out = _run_cascade(eng_req)
+                if ran == "cascade-pallas":
+                    self._pallas_proven = True
+            except Exception as exc:
+                # a compile failure of the Pallas fast path must not
+                # kill the run: permanently fall back to the XLA
+                # formulation (same numerics) and say so.  Only the
+                # FIRST pallas window qualifies — once the kernel has
+                # executed on this backend, a later failure is not a
+                # compile problem and must propagate.
+                if ran != "cascade-pallas" or self._pallas_proven:
+                    raise
+                self._pallas_ok = False
+                print(
+                    "Warning: Pallas kernel failed on this backend "
+                    f"({str(exc)[:120]}); falling back to the XLA "
+                    "cascade for the rest of the run"
                 )
+                log_event("pallas_fallback", error=str(exc)[:300])
+                ran = "cascade-xla"
+                out = _run_cascade("xla")
         else:
             idx, w = interp_indices_weights(taxis, target_times)
             data = host32
@@ -671,6 +703,16 @@ class LFProc:
         out = np.asarray(out)  # forces the device chain (host sync)
         t_dev = time.perf_counter() - t_dev0
         self.timings["device_s"] += t_dev
+        # ground truth of what ACTUALLY ran (post-execution: survives
+        # the Pallas fallback above)
+        self.engine_counts[ran] += 1
+        log_event(
+            "window_engine",
+            engine=ran,
+            rows=int(host.shape[0]),
+            emitted=n_out,
+            mesh=None if mesh is None else dict(mesh.shape),
+        )
         if ax != 0:
             out = np.moveaxis(out, 0, ax)
         coords = dict(window_patch.coords)
