@@ -89,6 +89,38 @@ class TestParameters:
             )
 
 
+class TestQuantizedFFTPath:
+    def test_lowpass_resample_qscale_bitwise_matches_decoded(self):
+        """The FFT engine's fused in-jit cast*scale is the same float
+        op sequence as host decode — bit-identical results."""
+        import jax.numpy as jnp
+
+        from tpudas.proc.lfproc import lowpass_resample
+
+        rng = np.random.default_rng(5)
+        q = rng.integers(-3000, 3000, size=(4096, 8)).astype(np.int16)
+        s = 2e-3
+        idx = np.arange(0, 4095, 8, dtype=np.int32)
+        w = np.zeros(idx.shape, np.float32)
+        dec = q.astype(np.float32) * np.float32(s)
+        ref = np.asarray(lowpass_resample(dec, 1e-3, 50.0, idx, w))
+        got = np.asarray(
+            lowpass_resample(jnp.asarray(q), 1e-3, 50.0, idx, w, qscale=s)
+        )
+        assert np.array_equal(got, ref)
+
+    def test_lowpass_resample_qscale_dtype_validation(self):
+        from tpudas.proc.lfproc import lowpass_resample
+
+        idx = np.arange(0, 100, 8, dtype=np.int32)
+        w = np.zeros(idx.shape, np.float32)
+        with pytest.raises(ValueError, match="dtype"):
+            lowpass_resample(
+                np.zeros((512, 4), np.float32), 1e-3, 50.0, idx, w,
+                qscale=0.5,
+            )
+
+
 class TestSchedule:
     def test_overlap_save_invariants(self):
         n, ps, buff = 500, 100, 10

@@ -19,10 +19,8 @@ supported auto-pipeline instead: P main-block inputs per grid step
 from __future__ import annotations
 
 import functools
+import os
 import sys
-import time
-
-import numpy as np
 
 sys.path.insert(0, ".")
 
@@ -36,9 +34,7 @@ C = 2048
 T = 129024
 
 
-import os as _os
-import sys as _sys
-_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from scan_harness import measure as _measure
 
 

@@ -7,10 +7,8 @@ how achieved HBM read bandwidth depends on chunk width and grid order.
 
 from __future__ import annotations
 
+import os
 import sys
-import time
-
-import numpy as np
 
 sys.path.insert(0, ".")
 
@@ -23,9 +21,7 @@ C = 2048
 T = 129024  # 16128 * 8
 
 
-import os as _os
-import sys as _sys
-_sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from scan_harness import measure as _measure
 
 

@@ -181,10 +181,13 @@ class LFProc:
         # flips False permanently if the Pallas fast path fails to
         # compile on this backend (engine falls back to the XLA
         # cascade — same numerics; see _process_window).  _pallas_proven
-        # latches True once a pallas window has executed, restricting
-        # the fallback to first-use (compile-time) failures.
+        # records the window shapes whose pallas compile has executed:
+        # jit caches per shape, so a tail window with a fresh n_out is
+        # a fresh compile and still deserves the fallback — but a
+        # failure on an already-proven shape is not a compile problem
+        # and propagates.
         self._pallas_ok = True
-        self._pallas_proven = False
+        self._pallas_proven = set()
 
     # configuration ----------------------------------------------------
     def _default_process_parameters(self):
@@ -650,18 +653,22 @@ class LFProc:
                     host32, plan, phase, n_out, eng, mesh=mesh, qscale=qs
                 )
 
+            shape_key = (
+                plan.ratio, plan.delay, int(host.shape[0]), n_out,
+                int(host.shape[1]), time_layout is not None,
+            )
             try:
                 out = _run_cascade(eng_req)
                 if ran == "cascade-pallas":
-                    self._pallas_proven = True
+                    self._pallas_proven.add(shape_key)
             except Exception as exc:
                 # a compile failure of the Pallas fast path must not
                 # kill the run: permanently fall back to the XLA
-                # formulation (same numerics) and say so.  Only the
-                # FIRST pallas window qualifies — once the kernel has
-                # executed on this backend, a later failure is not a
-                # compile problem and must propagate.
-                if ran != "cascade-pallas" or self._pallas_proven:
+                # formulation (same numerics) and say so.  Only a
+                # not-yet-proven window shape qualifies — once the
+                # kernel has executed for this shape, a later failure
+                # is not a compile problem and must propagate.
+                if ran != "cascade-pallas" or shape_key in self._pallas_proven:
                     raise
                 self._pallas_ok = False
                 print(
