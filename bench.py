@@ -565,6 +565,14 @@ def _e2e_child(backend: str) -> None:
 def _child() -> None:
     import jax
 
+    if os.environ.get("BENCH_COMPILE_CACHE", "1") == "1":
+        # persistent XLA cache: the probe/measure/e2e children (and
+        # successive bench runs on the same box) share compiled
+        # executables instead of each paying the 20-40 s compiles
+        from tpudas.utils.compile_cache import enable_compile_cache
+
+        enable_compile_cache()
+
     if os.environ.get("BENCH_MODE", "kernel") == "e2e":
         backend = jax.default_backend()
         print(

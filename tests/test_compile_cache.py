@@ -1,0 +1,53 @@
+"""Persistent XLA compilation cache (tpudas.utils.compile_cache)."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+@pytest.fixture
+def restore_cache_config():
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    prev_size = jax.config.jax_persistent_cache_min_entry_size_bytes
+    yield
+    jax.config.update("jax_compilation_cache_dir", prev_dir)
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs", prev_min
+    )
+    jax.config.update(
+        "jax_persistent_cache_min_entry_size_bytes", prev_size
+    )
+
+
+def test_env_opt_in_populates_cache(
+    tmp_path, monkeypatch, restore_cache_config
+):
+    import tpudas.utils.compile_cache as cc
+
+    d = str(tmp_path / "cache")
+    monkeypatch.setenv("TPUDAS_COMPILE_CACHE", d)
+    monkeypatch.setattr(cc, "_ENABLED", False)
+    assert cc.maybe_enable_from_env() == d
+    assert os.path.isdir(d)
+    # drop the entry thresholds so this tiny jit is cached
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    f = jax.jit(lambda x: jnp.sin(x) @ x.T)
+    f(np.ones((32, 32), np.float32)).block_until_ready()
+    assert len(glob.glob(os.path.join(d, "*"))) >= 1
+    # idempotent second call reports the active dir
+    assert cc.maybe_enable_from_env() == d
+
+
+def test_disabled_without_env(monkeypatch, restore_cache_config):
+    import tpudas.utils.compile_cache as cc
+
+    monkeypatch.delenv("TPUDAS_COMPILE_CACHE", raising=False)
+    monkeypatch.setattr(cc, "_ENABLED", False)
+    assert cc.maybe_enable_from_env() is None

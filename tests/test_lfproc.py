@@ -89,6 +89,60 @@ class TestParameters:
             )
 
 
+class TestH2DStaging:
+    def test_staged_run_byte_equal_to_unstaged(self, tmp_path, monkeypatch):
+        """The prefetch-thread H2D staging (assemble -> stage ->
+        compute -> write pipeline) must not change a single output
+        byte vs the serial path (TPUDAS_H2D_STAGE=0)."""
+        from tpudas import spool
+        from tpudas.proc.lfproc import LFProc
+        from tpudas.testing import make_synthetic_spool
+
+        d = tmp_path / "raw"
+        make_synthetic_spool(
+            d, n_files=4, file_duration=30.0, fs=100.0, n_ch=6, noise=0.01
+        )
+        results = {}
+        for label, env in (("staged", "1"), ("serial", "0")):
+            monkeypatch.setenv("TPUDAS_H2D_STAGE", env)
+            lfp = LFProc(spool(str(d)).sort("time").update())
+            lfp.update_processing_parameter(
+                output_sample_interval=1.0,
+                process_patch_size=60,
+                edge_buff_size=10,
+            )
+            out = tmp_path / f"out_{label}"
+            lfp.set_output_folder(str(out), delete_existing=True)
+            lfp.process_time_range(
+                np.datetime64("2023-03-22T00:00:00"),
+                np.datetime64("2023-03-22T00:02:00"),
+            )
+            results[label] = (
+                spool(str(out)).update().chunk(time=None)[0].host_data()
+            )
+        assert np.array_equal(results["staged"], results["serial"])
+
+    def test_stage_skips_oversized_windows(self, tmp_path, monkeypatch):
+        from tpudas import spool
+        from tpudas.proc.lfproc import LFProc
+        from tpudas.testing import make_synthetic_spool
+
+        d = tmp_path / "raw"
+        make_synthetic_spool(
+            d, n_files=2, file_duration=30.0, fs=100.0, n_ch=4, noise=0.01
+        )
+        monkeypatch.delenv("TPUDAS_H2D_STAGE", raising=False)
+        lfp = LFProc(spool(str(d)).sort("time").update())
+        monkeypatch.setattr(LFProc, "_STAGE_MAX_BYTES", 8)
+        patch, staged = lfp._load_and_stage(
+            np.datetime64("2023-03-22T00:00:00"),
+            np.datetime64("2023-03-22T00:00:30"),
+            "raise",
+        )
+        assert patch is not None
+        assert staged is None  # over the two-resident-windows budget
+
+
 class TestQuantizedFFTPath:
     def test_lowpass_resample_qscale_bitwise_matches_decoded(self):
         """The FFT engine's fused in-jit cast*scale is the same float

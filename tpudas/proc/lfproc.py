@@ -160,6 +160,12 @@ class LFProc:
     """
 
     def __init__(self, sp=None, mesh=None):
+        # TPUDAS_COMPILE_CACHE: persistent XLA compilation cache so a
+        # restarted deployment (or the next polling-round process)
+        # skips the first-window compile (tpudas.utils.compile_cache)
+        from tpudas.utils.compile_cache import maybe_enable_from_env
+
+        maybe_enable_from_env()
         self._spool = sp
         self._para = self._default_process_parameters()
         self._output_folder = None
@@ -452,7 +458,7 @@ class LFProc:
             if windows:
                 w0 = windows[0]
                 future = pool.submit(
-                    self._load_window,
+                    self._load_and_stage,
                     time_grid[w0[0]],
                     time_grid[w0[1]],
                     on_gap,
@@ -460,14 +466,14 @@ class LFProc:
             for i, (sel_lo, sel_hi, emit_lo, emit_hi) in enumerate(windows):
                 print("Processing patch ", str(i + 1))
                 t_wait = time.perf_counter()
-                window_patch = future.result()
+                window_patch, staged = future.result()
                 self.timings["assemble_s"] += (
                     time.perf_counter() - t_wait
                 )
                 if i + 1 < len(windows):
                     nxt = windows[i + 1]
                     future = pool.submit(
-                        self._load_window,
+                        self._load_and_stage,
                         time_grid[nxt[0]],
                         time_grid[nxt[1]],
                         on_gap,
@@ -481,8 +487,58 @@ class LFProc:
                     dt,
                     corner,
                     order,
+                    staged=staged,
                 )
         return len(windows)
+
+    @staticmethod
+    def _time_major_payload(window_patch):
+        """(time-major host array, qscale-or-None): the single source
+        of the quantized-ingest predicate and axis normalization,
+        shared by the prefetch-thread staging and _process_window so
+        the staged dtype can never desync from the ``qs`` flag."""
+        ax = window_patch.axis_of("time")
+        host = window_patch.host_data()
+        if ax != 0:
+            host = np.moveaxis(host, ax, 0)
+        qscale = window_patch.attrs.get("data_scale")
+        if host.dtype == np.int16 and qscale is not None:
+            return host, float(qscale)
+        return host, None
+
+    # windows larger than this are not pre-staged: staging keeps TWO
+    # windows resident (the computing one + the transferring one), and
+    # doubling a huge window's footprint can OOM configurations the
+    # serial path fits.  TPUDAS_H2D_STAGE=0 disables staging outright.
+    _STAGE_MAX_BYTES = 2 << 30
+
+    def _load_and_stage(self, bg, ed, on_gap):
+        """Prefetch-thread body: assemble the window, then START its
+        host->device transfer so H2D overlaps the previous window's
+        device compute and output write (the ingest pipeline is
+        assemble -> stage -> compute -> write; the reference's loop is
+        fully serial, lf_das.py:291-306).  Returns (patch, staged):
+        ``staged`` is the time-major device array (raw int16 for
+        quantized windows) or None when staging does not apply (mesh
+        runs place data with their own shardings)."""
+        window_patch = self._load_window(bg, ed, on_gap)
+        if (
+            window_patch is None
+            or self._mesh is not None
+            or os.environ.get("TPUDAS_H2D_STAGE", "1") == "0"
+        ):
+            return window_patch, None
+        host, qscale = self._time_major_payload(window_patch)
+        if qscale is None:
+            host = np.ascontiguousarray(host, dtype=np.float32)
+        if host.nbytes > self._STAGE_MAX_BYTES:
+            return window_patch, None
+        try:
+            staged = jax.device_put(host)
+        except Exception as exc:  # pragma: no cover - backend-specific
+            log_event("stage_h2d_failed", error=str(exc)[:200])
+            return window_patch, None
+        return window_patch, staged
 
     def _cascade_alignment(self, taxis, target_times, d_sec, dt):
         """If the (ms-quantized) target grid lands exactly on input
@@ -527,14 +583,18 @@ class LFProc:
             return None
         return ratio, phase
 
-    def _process_window(self, window_patch, target_times, dt, corner, order):
-        """Device side: fused filter+decimate, then write the interior."""
+    def _process_window(self, window_patch, target_times, dt, corner, order,
+                        staged=None):
+        """Device side: fused filter+decimate, then write the interior.
+
+        ``staged`` is the window's time-major device array when the
+        prefetch thread already started the H2D transfer
+        (:meth:`_load_and_stage`); host-side decisions still read the
+        numpy view, only the device payload is substituted."""
         if target_times.size == 0:
             return
         ax = window_patch.axis_of("time")
-        host = window_patch.host_data()
-        if ax != 0:
-            host = np.moveaxis(host, ax, 0)
+        host, qs = self._time_major_payload(window_patch)
         taxis = window_patch.coords["time"]
         d_sec = window_patch.get_sample_step("time")
         engine = self._para.get("engine", "auto")
@@ -623,19 +683,17 @@ class LFProc:
             )
         else:
             ran = "fft"
-        qscale = window_patch.attrs.get("data_scale")
         t_dev0 = time.perf_counter()
-        quantized = host.dtype == np.int16 and qscale is not None
-        if quantized:
-            # quantized window (tdas int16 fast path): ship the raw
-            # int16 across H2D and dequantize INSIDE the first device
-            # kernel — half the transfer bytes AND half the first
-            # stage's HBM read, with no intermediate f32 round trip
+        # quantized windows (qs set by _time_major_payload) ship the
+        # raw int16 payload and dequantize INSIDE the first device
+        # kernel — half the transfer bytes AND half the first stage's
+        # HBM read, with no intermediate f32 round trip
+        if staged is not None:
+            host32 = staged  # H2D already in flight (prefetch thread)
+        elif qs is not None:
             host32 = host
-            qs = float(qscale)
         else:
             host32 = host.astype(np.float32, copy=False)
-            qs = None
         if align is not None:
             def _run_cascade(eng):
                 if time_layout is not None:
@@ -656,6 +714,7 @@ class LFProc:
             shape_key = (
                 plan.ratio, plan.delay, int(host.shape[0]), n_out,
                 int(host.shape[1]), time_layout is not None,
+                str(host.dtype),  # int16 vs f32 payloads compile apart
             )
             try:
                 out = _run_cascade(eng_req)
@@ -667,8 +726,14 @@ class LFProc:
                 # formulation (same numerics) and say so.  Only a
                 # not-yet-proven window shape qualifies — once the
                 # kernel has executed for this shape, a later failure
-                # is not a compile problem and must propagate.
-                if ran != "cascade-pallas" or shape_key in self._pallas_proven:
+                # is not a compile problem and must propagate.  Nor is
+                # device memory exhaustion a kernel problem: retrying
+                # the same window on XLA would OOM just the same.
+                if (
+                    ran != "cascade-pallas"
+                    or shape_key in self._pallas_proven
+                    or "RESOURCE_EXHAUSTED" in str(exc)
+                ):
                     raise
                 self._pallas_ok = False
                 print(

@@ -1,0 +1,61 @@
+"""Persistent XLA compilation cache.
+
+The engine's first window pays the jit compile (~20-40 s on a TPU
+backend); in deployments that restart the process per polling round —
+and in the bench's probe/measure/e2e child processes — that cost
+recurs every start.  JAX's persistent compilation cache keys compiled
+executables by (HLO, compile options, backend) and reuses them across
+processes, cutting warm restarts to cache-hit latency.
+
+Opt-in: call :func:`enable_compile_cache` or set the
+``TPUDAS_COMPILE_CACHE`` env var (a directory path, or ``1`` for the
+default location) before the first jit executes.  LFProc and bench.py
+both honour the env var.
+
+The reference has no equivalent (scipy executes eagerly); this is the
+TPU rebuild's answer to its zero-warmup property (SURVEY.md §6).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+_ENABLED = False
+
+
+def default_cache_dir() -> str:
+    return os.path.join(tempfile.gettempdir(), "tpudas_jax_cache")
+
+
+def enable_compile_cache(path: str | None = None) -> str:
+    """Point JAX's persistent compilation cache at ``path`` (created if
+    missing) and return the directory used.  Idempotent; safe to call
+    before or after backend init, but must precede the first jit
+    compile to benefit it."""
+    global _ENABLED
+    import jax
+
+    if path is None:
+        env = os.environ.get("TPUDAS_COMPILE_CACHE")
+        path = env if env and env != "1" else default_cache_dir()
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # default min compile time (1 s) skips the small host-side jits;
+    # the window kernels all cost far more than that to compile
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    _ENABLED = True
+    return path
+
+
+def maybe_enable_from_env() -> str | None:
+    """Enable the cache iff ``TPUDAS_COMPILE_CACHE`` is set (library
+    entry points call this so deployments opt in by environment
+    alone).  Returns the directory when enabled."""
+    if _ENABLED:
+        import jax
+
+        return jax.config.jax_compilation_cache_dir
+    if os.environ.get("TPUDAS_COMPILE_CACHE"):
+        return enable_compile_cache()
+    return None
