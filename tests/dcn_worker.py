@@ -81,6 +81,41 @@ def main():
     want[halo:] = global_data[:-halo]  # stream start receives zeros
     assert np.array_equal(out, want), (out[:4], want[:4])
 
+    # the PRODUCT engine's sharded cascade across the DCN boundary:
+    # the compiled shard_map step (time sharding spans the two
+    # processes, so its halo ppermute crosses DCN) must be bit-equal
+    # to the single-process cascade (BASELINE config 5)
+    from tpudas.ops.fir import cascade_decimate, design_cascade
+    from tpudas.parallel.pipeline import (
+        _build_sharded_cascade_fn,
+        sharded_cascade_layout,
+    )
+
+    plan = design_cascade(100.0, 20, 0.45, 4)
+    n_out = 800  # each shard's halo (filter support) must fit its block
+    Cc = 8
+    layout = sharded_cascade_layout(
+        mesh, plan, plan.delay, n_out,
+        n_out * plan.ratio, n_ch_local=Cc // 4, engine="xla",
+    )
+    assert layout is not None, "2-shard layout must fit this window"
+    n_loc, t_local, halo_c = layout
+    T_target = 2 * t_local
+    rng = np.random.default_rng(0)
+    x_np = rng.standard_normal((T_target, Cc)).astype(np.float32)
+    x_glob = jax.make_array_from_callback(
+        x_np.shape, sharding, lambda idx: x_np[idx]
+    )
+    step = _build_sharded_cascade_fn(
+        plan, n_loc, halo_c, "xla", mesh, "time", "ch"
+    )
+    got = multihost_utils.process_allgather(step(x_glob), tiled=True)
+    ref = np.asarray(
+        cascade_decimate(x_np, plan, plan.delay, 2 * n_loc, "xla")
+    )
+    assert got.shape == ref.shape, (got.shape, ref.shape)
+    assert np.array_equal(got, ref), np.abs(got - ref).max()
+
     print(f"DCN_WORKER_OK pid={jax.process_index()}", flush=True)
 
 

@@ -529,10 +529,13 @@ class LFProc:
         ):
             return window_patch, None
         host, qscale = self._time_major_payload(window_patch)
+        # budget-check the PROJECTED device footprint before paying the
+        # host-side conversion copy (2 B/sample raw int16, else f32)
+        es = 2 if qscale is not None else 4
+        if host.size * es > self._STAGE_MAX_BYTES:
+            return window_patch, None
         if qscale is None:
             host = np.ascontiguousarray(host, dtype=np.float32)
-        if host.nbytes > self._STAGE_MAX_BYTES:
-            return window_patch, None
         try:
             staged = jax.device_put(host)
         except Exception as exc:  # pragma: no cover - backend-specific
@@ -726,13 +729,20 @@ class LFProc:
                 # formulation (same numerics) and say so.  Only a
                 # not-yet-proven window shape qualifies — once the
                 # kernel has executed for this shape, a later failure
-                # is not a compile problem and must propagate.  Nor is
-                # device memory exhaustion a kernel problem: retrying
-                # the same window on XLA would OOM just the same.
+                # is not a compile problem and must propagate.  Device
+                # (HBM) exhaustion also propagates — XLA would OOM on
+                # the same window — but VMEM exhaustion is exactly a
+                # kernel-formulation failure the fallback absorbs (the
+                # XLA path tiles through HBM instead of VMEM).
+                msg = str(exc)
+                hbm_oom = (
+                    "RESOURCE_EXHAUSTED" in msg
+                    and "vmem" not in msg.lower()
+                )
                 if (
                     ran != "cascade-pallas"
                     or shape_key in self._pallas_proven
-                    or "RESOURCE_EXHAUSTED" in str(exc)
+                    or hbm_oom
                 ):
                     raise
                 self._pallas_ok = False

@@ -41,9 +41,10 @@ def enable_compile_cache(path: str | None = None) -> str:
         path = env if env and env != "1" else default_cache_dir()
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
-    # default min compile time (1 s) skips the small host-side jits;
-    # the window kernels all cost far more than that to compile
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # JAX's default min-compile-time threshold (1 s) already skips the
+    # small host-side jits while caching the window kernels; it is
+    # deliberately NOT overridden here so operator-set thresholds
+    # (JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS) survive
     _ENABLED = True
     return path
 
