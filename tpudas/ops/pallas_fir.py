@@ -47,6 +47,7 @@ VMEM at (P, SB, CB) = (4, 128, 128), R=8: 4 mains x 512 KB x 2
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -57,9 +58,33 @@ from jax.experimental.pallas import tpu as pltpu
 __all__ = ["fir_decimate_pallas", "stage_input_rows"]
 
 _SB = 128  # output frames per sub-block (one MXU dot)
-_P = 4  # parallel main-block streams per grid step
+
+
+def _env_geom(name: str, default: int, multiple_of: int = 1) -> int:
+    """Env-tunable geometry knob: empty/unset -> default; anything
+    else must be a positive int (and a lane multiple where required)
+    — fail at import with the variable named, not mid-run."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r} is not an integer") from None
+    if val < 1 or val % multiple_of:
+        raise ValueError(
+            f"{name} must be a positive multiple of {multiple_of}, "
+            f"got {val}"
+        )
+    return val
+
+
+# geometry is env-tunable so on-chip sweeps need no code edits; the
+# engine's chain layout reads the same constants, keeping the sizing
+# math and the kernel grid in lockstep
+_P = _env_geom("TPUDAS_PALLAS_P", 4)  # parallel DMA streams
 _KB = _SB * _P  # output frames per grid step (the grid quantum)
-_CB = 128  # channels per program (lane width)
+_CB = _env_geom("TPUDAS_PALLAS_CB", 128, multiple_of=128)  # channel block
 
 
 def _round_up(x: int, m: int) -> int:

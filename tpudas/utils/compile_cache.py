@@ -41,6 +41,25 @@ def enable_compile_cache(path: str | None = None) -> str:
         path = env if env and env != "1" else default_cache_dir()
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
+    # the cache singleton binds to the directory it first initialized
+    # with; re-pointing the config alone leaves writes going wherever
+    # the singleton was born (even when the CONFIG value round-trips
+    # back unchanged), so reset unconditionally — cheap, and correct
+    # regardless of who touched the config in between
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception as exc:  # pragma: no cover - private-API drift
+        import warnings
+
+        warnings.warn(
+            "could not reset JAX's compilation-cache singleton "
+            f"({exc!r}); cache writes may target a previously "
+            "configured directory",
+            RuntimeWarning,
+            stacklevel=2,
+        )
     # JAX's default min-compile-time threshold (1 s) already skips the
     # small host-side jits while caching the window kernels; it is
     # deliberately NOT overridden here so operator-set thresholds
