@@ -162,6 +162,27 @@ class TestPallasKernel:
         with pytest.raises(ValueError, match="tap frames"):
             fir_decimate_pallas(x, hb, 2, n_out=64, interpret=True)
 
+    def test_env_geometry_knob_validation(self, monkeypatch):
+        """TPUDAS_PALLAS_P/CB: empty means default; bad values fail
+        fast naming the variable (not mid-run at a lazy import)."""
+        from tpudas.ops.pallas_fir import _env_geom
+
+        monkeypatch.delenv("TPUDAS_TEST_GEOM", raising=False)
+        assert _env_geom("TPUDAS_TEST_GEOM", 4) == 4
+        monkeypatch.setenv("TPUDAS_TEST_GEOM", "  ")
+        assert _env_geom("TPUDAS_TEST_GEOM", 4) == 4
+        monkeypatch.setenv("TPUDAS_TEST_GEOM", "8")
+        assert _env_geom("TPUDAS_TEST_GEOM", 4) == 8
+        monkeypatch.setenv("TPUDAS_TEST_GEOM", "abc")
+        with pytest.raises(ValueError, match="TPUDAS_TEST_GEOM"):
+            _env_geom("TPUDAS_TEST_GEOM", 4)
+        monkeypatch.setenv("TPUDAS_TEST_GEOM", "0")
+        with pytest.raises(ValueError, match="positive"):
+            _env_geom("TPUDAS_TEST_GEOM", 4)
+        monkeypatch.setenv("TPUDAS_TEST_GEOM", "100")
+        with pytest.raises(ValueError, match="multiple"):
+            _env_geom("TPUDAS_TEST_GEOM", 128, multiple_of=128)
+
     def test_3x_split_dot_accuracy(self):
         """The TPU kernel's 3-pass bf16 matmul emulation (interpret
         mode runs exact f32 instead, so this exercises the split
