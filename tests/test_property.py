@@ -1,0 +1,138 @@
+"""Property-based tests (hypothesis) for host-side invariants.
+
+Generalizes the hand-rolled fixed-size checks in test_lfproc /
+test_tdas across the whole valid parameter space: the overlap-save
+scheduler's tiling algebra, the reference filename contract, and the
+tdas round-trip including int16 quantization error bounds (SURVEY.md
+§4 test strategy: property tests for the chunking/seam logic).
+"""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from tpudas.proc.lfproc import schedule_windows
+from tpudas.proc.naming import get_filename, get_timestr
+
+
+class TestScheduleProperties:
+    @settings(max_examples=200, deadline=None)
+    @given(
+        n=st.integers(30, 5000),
+        ps=st.integers(10, 800),
+        buff=st.integers(1, 100),
+    )
+    def test_overlap_save_tiling(self, n, ps, buff):
+        # the scheduler clamps the patch to the grid before validating
+        eff_ps = min(ps, n - 1)
+        if eff_ps <= 2 * buff:
+            with pytest.raises(ValueError):
+                schedule_windows(n, ps, buff)
+            return
+        wins = schedule_windows(n, ps, buff)
+        if not wins:
+            return
+        # emitted interiors start at buff and tile contiguously
+        assert wins[0][2] == buff
+        for (sl, sh, el, eh), (nsl, nsh, nel, neh) in zip(wins, wins[1:]):
+            assert nel == eh, "seam between consecutive windows"
+        for sl, sh, el, eh in wins:
+            # selections stay inside the grid, emits inside selections
+            assert 0 <= sl < sh < n
+            assert sl <= el < eh or el == eh
+            assert eh <= sh
+            # the halo guarantee: every emitted point has >= buff
+            # points of selected context on the left; on the right the
+            # stream end may truncate (the tail window emits to the
+            # final grid point, matching the reference's loop)
+            assert el - sl >= buff
+        # no window selects more than the configured patch size
+        assert all(sh - sl <= ps for sl, sh, _, _ in wins)
+
+    @settings(max_examples=100, deadline=None)
+    @given(
+        n=st.integers(30, 5000),
+        ps=st.integers(10, 800),
+        buff=st.integers(1, 100),
+    )
+    def test_emitted_points_unique_and_sorted(self, n, ps, buff):
+        if min(ps, n - 1) <= 2 * buff:
+            return
+        wins = schedule_windows(n, ps, buff)
+        emitted = [i for _, _, el, eh in wins for i in range(el, eh)]
+        assert emitted == sorted(set(emitted)), "overlap or disorder"
+
+
+class TestNamingProperties:
+    @settings(max_examples=200, deadline=None)
+    @given(ms=st.integers(0, 4_102_444_800_000))  # epoch .. 2100-01-01
+    def test_timestr_contract_everywhere(self, ms):
+        t = np.datetime64(ms, "ms")
+        s = get_timestr(t)
+        # the reference contract (lf_das.py:23-26): str()[:21] with
+        # colons removed -> 19 chars, one sub-second digit
+        assert len(s) == 19
+        assert ":" not in s
+        assert s == str(t)[:21].replace(":", "")
+        name = get_filename(t, t + np.timedelta64(100, "s"))
+        assert name.startswith("LFDAS_") and name.endswith(".h5")
+
+
+def _patch_from_data(data):
+    from tpudas.core.patch import Patch
+
+    t, c = data.shape
+    times = np.datetime64("2023-03-22T00:00:00", "ns") + np.arange(
+        t
+    ) * np.timedelta64(10_000_000, "ns")
+    dists = np.arange(c, dtype=np.float64) * 5.0
+    return Patch(
+        data=data,
+        coords={"time": times, "distance": dists},
+        dims=("time", "distance"),
+        attrs={"d_time": 0.01, "d_distance": 5.0},
+    )
+
+
+class TestTdasRoundTrip:
+    @settings(max_examples=25, deadline=None)
+    @given(
+        t=st.integers(4, 200),
+        c=st.integers(1, 32),
+        seed=st.integers(0, 2**31 - 1),
+    )
+    def test_float32_lossless(self, tmp_path_factory, t, c, seed):
+        from tpudas.io.registry import read_file, write_patch
+
+        rng = np.random.default_rng(seed)
+        data = rng.standard_normal((t, c)).astype(np.float32)
+        path = str(tmp_path_factory.mktemp("tdas") / "p.tdas")
+        write_patch(_patch_from_data(data), path, format="tdas")
+        (back,) = read_file(path, format="tdas")
+        assert np.array_equal(back.host_data(), data)
+
+    @settings(max_examples=25, deadline=None)
+    @given(
+        t=st.integers(4, 200),
+        c=st.integers(1, 16),
+        scale_exp=st.integers(-6, -1),
+        seed=st.integers(0, 2**31 - 1),
+    )
+    def test_int16_quantization_error_bounded(
+        self, tmp_path_factory, t, c, scale_exp, seed
+    ):
+        from tpudas.io.registry import read_file, write_patch
+
+        rng = np.random.default_rng(seed)
+        scale = 10.0 ** scale_exp
+        # keep data inside the representable range scale * 32767
+        data = (
+            rng.uniform(-0.9, 0.9, size=(t, c)) * scale * 32000
+        ).astype(np.float32)
+        path = str(tmp_path_factory.mktemp("tdas") / "q.tdas")
+        write_patch(
+            _patch_from_data(data), path, format="tdas",
+            dtype="int16", scale=scale,
+        )
+        back = read_file(path, format="tdas")[0].host_data()
+        assert np.abs(back - data).max() <= scale * 0.5 + 1e-7
