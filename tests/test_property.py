@@ -135,4 +135,7 @@ class TestTdasRoundTrip:
             dtype="int16", scale=scale,
         )
         back = read_file(path, format="tdas")[0].host_data()
-        assert np.abs(back - data).max() <= scale * 0.5 + 1e-7
+        # half a code step, plus float32 ulp slack for the writer's
+        # round-at-.5 boundary and the decode multiply
+        bound = scale * 0.5 + np.abs(data).max() * 1e-6
+        assert np.abs(back - data).max() <= bound

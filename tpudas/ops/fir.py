@@ -381,6 +381,23 @@ def _check_quantized(x, qscale):
         raise ValueError(f"qscale given but data dtype is {x.dtype}")
 
 
+def shift_to_phase(x, phase: int, delay: int, axis: int = 0):
+    """Align a stream so causal cascade output ``k`` lands on
+    zero-phase full-rate index ``phase + k*ratio``: drop
+    ``phase - delay`` leading rows, or left-pad when the requested
+    phase precedes the filter delay.  Single source for every cascade
+    entry point (single-device, time-sharded, window-batched)."""
+    import jax.numpy as jnp
+
+    shift = int(phase) - int(delay)
+    if shift >= 0:
+        idx = (slice(None),) * axis + (slice(shift, None),)
+        return x[idx]
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (-shift, 0)
+    return jnp.pad(x, pad)
+
+
 def _apply_cascade_stages(x, blocked, n_out, use_pallas, interpret,
                           qscale=None):
     """Traceable cascade body shared by the jit path and the shard_map
@@ -540,11 +557,7 @@ def cascade_decimate(
     x = jnp.asarray(x)
     _check_quantized(x, qscale)
     quantized = qscale is not None
-    shift = int(phase) - plan.delay
-    if shift >= 0:
-        x2 = x[shift:]
-    else:
-        x2 = jnp.pad(x, ((-shift, 0), (0, 0)))
+    x2 = shift_to_phase(x, phase, plan.delay)
     args = (x2, jnp.float32(qscale)) if quantized else (x2,)
     if mesh is None:
         fn = _build_cascade_fn(

@@ -213,7 +213,11 @@ def sharded_cascade_decimate(
     """
     import jax.numpy as jnp
 
-    from tpudas.ops.fir import _check_quantized, resolve_cascade_engine
+    from tpudas.ops.fir import (
+        _check_quantized,
+        resolve_cascade_engine,
+        shift_to_phase,
+    )
 
     nt = mesh.shape[time_axis]
     nc = mesh.shape[ch_axis]
@@ -233,11 +237,7 @@ def sharded_cascade_decimate(
     else:
         x = jnp.asarray(x, jnp.float32)
     C = int(x.shape[1])
-    shift = int(phase) - plan.delay
-    if shift >= 0:
-        x2 = x[shift:]
-    else:
-        x2 = jnp.pad(x, ((-shift, 0), (0, 0)))
+    x2 = shift_to_phase(x, phase, plan.delay)
     T_target = nt * t_local
     pad_t = T_target - int(x2.shape[0])
     if pad_t > 0:
