@@ -179,6 +179,51 @@ class TestShardedCascade:
             )
             assert np.array_equal(out[wdx], ref), wdx
 
+    def test_lfproc_window_dp_byte_equal(self, tmp_path):
+        """LFProc with window_dp batches steady-state windows over the
+        mesh "time" axis and stays byte-identical to the single-device
+        serial run."""
+        from tpudas import spool
+        from tpudas.proc.lfproc import LFProc
+        from tpudas.testing import make_synthetic_spool
+        from tpudas.utils.logging import set_log_handler
+
+        d = tmp_path / "raw"
+        make_synthetic_spool(
+            d, n_files=6, file_duration=30.0, fs=100.0, n_ch=6, noise=0.01
+        )
+        t0 = np.datetime64("2023-03-22T00:00:00")
+        t1 = np.datetime64("2023-03-22T00:03:00")
+        events = []
+        set_log_handler(events.append)
+        try:
+            results = {}
+            for label, mesh, dp in (
+                ("serial", None, False),
+                ("dp", make_mesh(8, time_shards=2), True),
+            ):
+                lfp = LFProc(spool(str(d)).sort("time").update(), mesh=mesh)
+                lfp.update_processing_parameter(
+                    output_sample_interval=1.0,
+                    process_patch_size=60,
+                    edge_buff_size=10,
+                    window_dp=dp,
+                )
+                out = tmp_path / f"out_{label}"
+                lfp.set_output_folder(str(out), delete_existing=True)
+                lfp.process_time_range(t0, t1)
+                results[label] = (
+                    spool(str(out)).update().chunk(time=None)[0].host_data()
+                )
+                if dp:
+                    assert sum(lfp.engine_counts.values()) == 4
+        finally:
+            set_log_handler(None)
+        batches = [e for e in events if e["event"] == "window_dp_batch"]
+        assert batches, "no DP batch actually ran"
+        assert sum(e["windows"] for e in batches) >= 2
+        assert np.array_equal(results["serial"], results["dp"])
+
     def test_window_dp_custom_single_axis_mesh(self):
         """A 1-axis DP mesh (no channel axis) leaves channels
         unsharded instead of crashing on the spec."""

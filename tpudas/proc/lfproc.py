@@ -220,6 +220,15 @@ class LFProc:
             # the ratio factors; FFT engine otherwise. "fft"/"cascade"
             # force one path.
             "engine": "auto",
+            # window-level DATA parallelism (BASELINE "spool chunks
+            # pmapped"): with a mesh whose "time" axis has size > 1,
+            # batches of same-shape cascade-aligned windows run
+            # together, one window per time-axis slot, channels still
+            # sharded over "ch". Windows that do not line up (edges,
+            # gaps, FFT-path grids) fall back to per-window execution.
+            # Repurposes the time axis: window-internal time sharding
+            # is off while this is set.
+            "window_dp": False,
         }
 
     _ENGINES = ("auto", "fft", "cascade")
@@ -453,42 +462,177 @@ class LFProc:
         windows = schedule_windows(len(time_grid), patch_size, buff_size)
         corner = 1.0 / dt / 2.0 * 0.9  # 0.9x post-decimation Nyquist
 
+        if (
+            self._para.get("window_dp")
+            and self._mesh is not None
+            and self._mesh.shape.get("time", 1) > 1
+        ):
+            return self._process_segment_dp(
+                time_grid, windows, on_gap, dt, corner, order
+            )
+
+        for i, loaded, emit_times in self._iter_windows(
+            time_grid, windows, on_gap, self._load_and_stage
+        ):
+            window_patch, staged = loaded
+            if window_patch is None:
+                log_event("window_skipped_gap", index=i + 1)
+                continue
+            self._process_window(
+                window_patch, emit_times, dt, corner, order, staged=staged
+            )
+        return len(windows)
+
+    def _iter_windows(self, time_grid, windows, on_gap, loader):
+        """Prefetching window iterator shared by the serial and
+        window-DP drivers: ``loader(bg, ed, on_gap)`` runs one window
+        ahead on the worker thread; yields ``(i, loaded, emit_times)``
+        with assemble-wait time accounted."""
         with ThreadPoolExecutor(max_workers=1) as pool:
             future = None
             if windows:
                 w0 = windows[0]
                 future = pool.submit(
-                    self._load_and_stage,
-                    time_grid[w0[0]],
-                    time_grid[w0[1]],
-                    on_gap,
+                    loader, time_grid[w0[0]], time_grid[w0[1]], on_gap
                 )
             for i, (sel_lo, sel_hi, emit_lo, emit_hi) in enumerate(windows):
                 print("Processing patch ", str(i + 1))
                 t_wait = time.perf_counter()
-                window_patch, staged = future.result()
-                self.timings["assemble_s"] += (
-                    time.perf_counter() - t_wait
-                )
+                loaded = future.result()
+                self.timings["assemble_s"] += time.perf_counter() - t_wait
                 if i + 1 < len(windows):
                     nxt = windows[i + 1]
                     future = pool.submit(
-                        self._load_and_stage,
-                        time_grid[nxt[0]],
-                        time_grid[nxt[1]],
-                        on_gap,
+                        loader, time_grid[nxt[0]], time_grid[nxt[1]], on_gap
                     )
-                if window_patch is None:
-                    log_event("window_skipped_gap", index=i + 1)
-                    continue
-                self._process_window(
-                    window_patch,
-                    time_grid[emit_lo:emit_hi],
-                    dt,
-                    corner,
-                    order,
-                    staged=staged,
+                yield i, loaded, time_grid[emit_lo:emit_hi]
+
+    def _dp_window_info(self, window_patch, target_times, dt, corner, order):
+        """Batchability probe for the window-DP driver: the (plan,
+        phase, n_out, shape, dtype, qscale) key a window must share
+        with its batch — or ``None`` when the window needs the full
+        per-window path (FFT-aligned grids, undersized halos, engine
+        config 'fft')."""
+        if self._para.get("engine", "auto") not in ("auto", "cascade"):
+            return None
+        if target_times.size == 0:
+            return None
+        from tpudas.ops.fir import design_cascade, edge_support_samples
+
+        host, qs = self._time_major_payload(window_patch)
+        taxis = window_patch.coords["time"]
+        d_sec = window_patch.get_sample_step("time")
+        align = self._cascade_alignment(taxis, target_times, d_sec, dt)
+        if align is None:
+            return None
+        ratio, phase = align
+        plan = design_cascade(1.0 / d_sec, ratio, corner, int(order))
+        supp = edge_support_samples(plan, 1e-3)
+        tail = host.shape[0] - (phase + (target_times.size - 1) * ratio)
+        if supp > phase or supp >= tail:
+            return None  # edge-artifact window: per-window path warns
+        key = (
+            plan, phase, int(target_times.size), host.shape,
+            str(host.dtype), qs,
+        )
+        return {"key": key, "host": host, "plan": plan, "phase": phase,
+                "n_out": int(target_times.size), "qs": qs}
+
+    def _process_segment_dp(self, time_grid, windows, on_gap, dt, corner,
+                            order) -> int:
+        """Window-level data parallelism over the overlap-save
+        schedule: consecutive windows sharing one (plan, phase, n_out,
+        shape, dtype, scale) batch over the mesh's "time" axis (one
+        window per slot, channels still over "ch") and are bit-equal
+        to per-window execution; anything that does not line up takes
+        the normal per-window path."""
+        from tpudas.ops.fir import stage_engines
+        from tpudas.parallel.batch import batched_cascade_decimate
+
+        mesh = self._mesh
+        nb = mesh.shape["time"]
+        pending = []  # [(patch, emit_times, info)]
+
+        def run_batch():
+            """Device compute only — emission happens in flush(), so a
+            failure here cannot double-emit already-written windows."""
+            infos = [p[2] for p in pending]
+            plan = infos[0]["plan"]
+            phase = infos[0]["phase"]
+            n_out = infos[0]["n_out"]
+            qs = infos[0]["qs"]
+            stack = np.stack([i["host"] for i in infos])
+            n_ch_local = -(-stack.shape[2] // mesh.shape["ch"])
+            # mirror the per-window engine request: a previous Pallas
+            # compile failure keeps DP batches on the XLA formulation
+            # instead of re-raising (and re-serializing) every batch
+            eng_req = "auto" if self._pallas_ok else "xla"
+            stages = stage_engines(plan, n_out, n_ch_local, eng_req)
+            ran = "cascade-pallas" if "pallas" in stages else "cascade-xla"
+            t0 = time.perf_counter()
+            out = np.asarray(
+                batched_cascade_decimate(
+                    mesh, stack, plan, phase, n_out, engine=eng_req,
+                    batch_axis="time", ch_axis="ch", qscale=qs,
                 )
+            )
+            t_dev = time.perf_counter() - t0
+            self.timings["device_s"] += t_dev
+            return out, ran, int(stack.shape[1]), t_dev
+
+        def flush():
+            if not pending:
+                return
+            if len(pending) == 1:
+                patch, emit_times, _ = pending[0]
+                self._process_window(patch, emit_times, dt, corner, order)
+                pending.clear()
+                return
+            try:
+                out, ran, rows, t_dev = run_batch()
+            except Exception as exc:
+                # a batch-COMPUTE failure degrades to the per-window
+                # path, which has its own (shape-keyed) fallback
+                log_event("window_dp_fallback", error=str(exc)[:300])
+                for patch, emit_times, _ in pending:
+                    self._process_window(
+                        patch, emit_times, dt, corner, order
+                    )
+                pending.clear()
+                return
+            log_event(
+                "window_dp_batch", windows=len(pending), engine=ran,
+                rows=rows, emitted=int(pending[0][2]["n_out"]),
+            )
+            for i, (patch, emit_times, _) in enumerate(pending):
+                self._emit_window_output(
+                    patch, emit_times, dt, out[i], ran,
+                    rows=rows, t_dev=t_dev / len(pending),
+                )
+            pending.clear()
+
+        for i, window_patch, emit_times in self._iter_windows(
+            time_grid, windows, on_gap, self._load_window
+        ):
+            if window_patch is None:
+                flush()
+                log_event("window_skipped_gap", index=i + 1)
+                continue
+            info = self._dp_window_info(
+                window_patch, emit_times, dt, corner, order
+            )
+            if info is None:
+                flush()
+                self._process_window(
+                    window_patch, emit_times, dt, corner, order
+                )
+                continue
+            if pending and info["key"] != pending[0][2]["key"]:
+                flush()
+            pending.append((window_patch, emit_times, info))
+            if len(pending) == nb:
+                flush()
+        flush()
         return len(windows)
 
     @staticmethod
@@ -785,14 +929,26 @@ class LFProc:
         out = np.asarray(out)  # forces the device chain (host sync)
         t_dev = time.perf_counter() - t_dev0
         self.timings["device_s"] += t_dev
+        self._emit_window_output(
+            window_patch, target_times, dt, out, ran,
+            rows=int(host.shape[0]), t_dev=t_dev,
+        )
+
+    def _emit_window_output(self, window_patch, target_times, dt, out, ran,
+                            rows, t_dev=0.0):
+        """Shared tail of window processing: observability, coords,
+        attrs, and the HDF5 write — used by the serial path and by the
+        window-DP driver (which computes ``out`` in a batch)."""
+        ax = window_patch.axis_of("time")
+        mesh = self._mesh
         # ground truth of what ACTUALLY ran (post-execution: survives
         # the Pallas fallback above)
         self.engine_counts[ran] += 1
         log_event(
             "window_engine",
             engine=ran,
-            rows=int(host.shape[0]),
-            emitted=n_out,
+            rows=rows,
+            emitted=int(target_times.size),
             mesh=None if mesh is None else dict(mesh.shape),
         )
         if ax != 0:
