@@ -224,6 +224,53 @@ class TestShardedCascade:
         assert sum(e["windows"] for e in batches) >= 2
         assert np.array_equal(results["serial"], results["dp"])
 
+    def test_lfproc_window_dp_failure_latches_off(self, tmp_path,
+                                                  monkeypatch):
+        """One batch-compute failure disables window_dp for the rest
+        of the run (no doomed stack transfer per batch) while the
+        per-window path completes the work."""
+        import tpudas.parallel.batch as batch_mod
+        from tpudas import spool
+        from tpudas.proc.lfproc import LFProc
+        from tpudas.testing import make_synthetic_spool
+        from tpudas.utils.logging import set_log_handler
+
+        d = tmp_path / "raw"
+        make_synthetic_spool(
+            d, n_files=6, file_duration=30.0, fs=100.0, n_ch=6, noise=0.01
+        )
+
+        def boom(*a, **k):
+            raise RuntimeError("batch compute failure (synthetic)")
+
+        monkeypatch.setattr(batch_mod, "batched_cascade_decimate", boom)
+        events = []
+        set_log_handler(events.append)
+        try:
+            lfp = LFProc(
+                spool(str(d)).sort("time").update(),
+                mesh=make_mesh(8, time_shards=2),
+            )
+            lfp.update_processing_parameter(
+                output_sample_interval=1.0,
+                process_patch_size=60,
+                edge_buff_size=10,
+                window_dp=True,
+            )
+            out = tmp_path / "out"
+            lfp.set_output_folder(str(out), delete_existing=True)
+            lfp.process_time_range(
+                np.datetime64("2023-03-22T00:00:00"),
+                np.datetime64("2023-03-22T00:03:00"),
+            )
+        finally:
+            set_log_handler(None)
+        assert not lfp._window_dp_ok
+        falls = [e for e in events if e["event"] == "window_dp_fallback"]
+        assert len(falls) == 1, falls  # latched after the first failure
+        assert sum(lfp.engine_counts.values()) == 4  # all windows done
+        assert len(list(out.iterdir())) == 4
+
     def test_window_dp_custom_single_axis_mesh(self):
         """A 1-axis DP mesh (no channel axis) leaves channels
         unsharded instead of crashing on the spec."""

@@ -194,6 +194,10 @@ class LFProc:
         # and propagates.
         self._pallas_ok = True
         self._pallas_proven = set()
+        # latches False after a window-DP batch-compute failure: the
+        # rest of the run executes per-window instead of paying a
+        # doomed stack transfer on every batch
+        self._window_dp_ok = True
 
     # configuration ----------------------------------------------------
     def _default_process_parameters(self):
@@ -464,6 +468,7 @@ class LFProc:
 
         if (
             self._para.get("window_dp")
+            and self._window_dp_ok
             and self._mesh is not None
             and self._mesh.shape.get("time", 1) > 1
         ):
@@ -531,12 +536,21 @@ class LFProc:
         tail = host.shape[0] - (phase + (target_times.size - 1) * ratio)
         if supp > phase or supp >= tail:
             return None  # edge-artifact window: per-window path warns
+        # host-residency budget (the serial path's _STAGE_MAX_BYTES
+        # analogue): a batch holds nb windows PLUS their np.stack copy
+        nb = self._mesh.shape["time"]
+        if host.nbytes * (nb + 1) > self._DP_MAX_BATCH_BYTES:
+            return None
         key = (
             plan, phase, int(target_times.size), host.shape,
             str(host.dtype), qs,
         )
         return {"key": key, "host": host, "plan": plan, "phase": phase,
                 "n_out": int(target_times.size), "qs": qs}
+
+    # cap on (batch windows + stack copy) host bytes before window-DP
+    # degrades to per-window execution — mirrors _STAGE_MAX_BYTES
+    _DP_MAX_BATCH_BYTES = 8 << 30
 
     def _process_segment_dp(self, time_grid, windows, on_gap, dt, corner,
                             order) -> int:
@@ -592,7 +606,15 @@ class LFProc:
                 out, ran, rows, t_dev = run_batch()
             except Exception as exc:
                 # a batch-COMPUTE failure degrades to the per-window
-                # path, which has its own (shape-keyed) fallback
+                # path, which has its own (shape-keyed) fallback — and
+                # latches window_dp off for the rest of the run, since
+                # retrying pays the doomed stack transfer per batch
+                self._window_dp_ok = False
+                print(
+                    "Warning: window-DP batch failed "
+                    f"({str(exc)[:120]}); per-window execution for "
+                    "the rest of the run"
+                )
                 log_event("window_dp_fallback", error=str(exc)[:300])
                 for patch, emit_times, _ in pending:
                     self._process_window(
@@ -618,8 +640,12 @@ class LFProc:
                 flush()
                 log_event("window_skipped_gap", index=i + 1)
                 continue
-            info = self._dp_window_info(
-                window_patch, emit_times, dt, corner, order
+            info = (
+                self._dp_window_info(
+                    window_patch, emit_times, dt, corner, order
+                )
+                if self._window_dp_ok  # mid-segment latch flip
+                else None
             )
             if info is None:
                 flush()
