@@ -537,9 +537,10 @@ class LFProc:
         if supp > phase or supp >= tail:
             return None  # edge-artifact window: per-window path warns
         # host-residency budget (the serial path's _STAGE_MAX_BYTES
-        # analogue): a batch holds nb windows PLUS their np.stack copy
+        # analogue): at flush time all nb pending windows are resident
+        # PLUS their nb-window np.stack copy -> peak ~2*nb windows
         nb = self._mesh.shape["time"]
-        if host.nbytes * (nb + 1) > self._DP_MAX_BATCH_BYTES:
+        if host.nbytes * nb * 2 > self._DP_MAX_BATCH_BYTES:
             return None
         key = (
             plan, phase, int(target_times.size), host.shape,

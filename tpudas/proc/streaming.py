@@ -103,13 +103,15 @@ def run_lowpass_realtime(
     on_gap=None,
     filter_order=None,
     data_gap_tolorance=None,
+    window_dp=None,
     counters=None,
     mesh=None,
 ):
     """Poll ``source`` and keep the low-pass output current.
 
     ``engine`` / ``on_gap`` / ``filter_order`` / ``data_gap_tolorance``
-    are forwarded to :class:`LFProc` (None keeps its defaults), so the
+    / ``window_dp`` are forwarded to :class:`LFProc` (None keeps its
+    defaults), so the
     streaming path can run the cascade engine and gap policies the batch
     path has. ``mesh`` (a :class:`jax.sharding.Mesh`) runs each round's
     windows device-sharded — see :attr:`LFProc.mesh`.  Pass a :class:`tpudas.utils.profiling.Counters` to
@@ -131,6 +133,7 @@ def run_lowpass_realtime(
             ("on_gap", on_gap),
             ("filter_order", filter_order),
             ("data_gap_tolorance", data_gap_tolorance),
+            ("window_dp", window_dp),
         )
         if v is not None
     }
