@@ -635,35 +635,67 @@ def _child() -> None:
         kernel, flops_win = _build_fft_step(T, C, fs, dt_out, order)
         T_used = T
 
+    pallas_impl = None
     try:
         elapsed, iters_done, n_resident = _measure(
             kernel, T_used, C, iters, include_h2d
         )
     except Exception as exc:
         # a Mosaic/compile failure of the Pallas fast path must not
-        # cost the round's headline number: fall back to the XLA
-        # formulation and say so in the JSON
+        # cost the round's headline number.  Fallback chain: the v1
+        # VPU kernel (proven on this hardware — the 29 G record) and
+        # only then the XLA formulation.  Either way the JSON says so.
         if not (engine == "cascade" and use_pallas):
             raise
         pallas_error = str(exc)[:300]
-        print(
-            f"[bench] pallas path failed ({pallas_error[:120]}); "
-            "falling back to cascade-xla",
-            file=sys.stderr,
-            flush=True,
-        )
-        use_pallas = False
-        kernel, flops_win, T_used, report = _build_cascade_step(
-            T, C, fs, dt_out, order, False, mesh, time_shards
-        )
-        # the failed pallas attempt may have eaten most of the watchdog
-        # budget — a short re-measure that prints SOMETHING beats the
-        # parent killing the child mid-way with no JSON at all
-        left = remaining - (time.monotonic() - child_start)
-        iters_fb = iters if left > 180 else max(4, min(iters, 16))
-        elapsed, iters_done, n_resident = _measure(
-            kernel, T_used, C, iters_fb, include_h2d
-        )
+        elapsed = None
+        # an EXPLICIT TPUDAS_PALLAS_IMPL (either value) is respected:
+        # the operator chose an implementation, so its failure goes
+        # straight to the XLA tier instead of being second-guessed
+        if "TPUDAS_PALLAS_IMPL" not in os.environ:
+            print(
+                f"[bench] pallas v2 failed ({pallas_error[:120]}); "
+                "retrying with the v1 kernel",
+                file=sys.stderr,
+                flush=True,
+            )
+            import tpudas.ops.fir as _fir
+
+            os.environ["TPUDAS_PALLAS_IMPL"] = "v1"
+            _fir._clear_cascade_caches()  # retrace (incl. mesh paths)
+            try:
+                kernel, flops_win, T_used, report = _build_cascade_step(
+                    T, C, fs, dt_out, order, True, mesh, time_shards
+                )
+                left = remaining - (time.monotonic() - child_start)
+                iters_v1 = iters if left > 240 else max(4, min(iters, 32))
+                elapsed, iters_done, n_resident = _measure(
+                    kernel, T_used, C, iters_v1, include_h2d
+                )
+                pallas_impl = "v1"
+            except Exception as exc2:
+                pallas_error += " | v1: " + str(exc2)[:200]
+                _fir._clear_cascade_caches()
+                elapsed = None
+        if elapsed is None:
+            print(
+                f"[bench] pallas path failed ({pallas_error[:120]}); "
+                "falling back to cascade-xla",
+                file=sys.stderr,
+                flush=True,
+            )
+            use_pallas = False
+            kernel, flops_win, T_used, report = _build_cascade_step(
+                T, C, fs, dt_out, order, False, mesh, time_shards
+            )
+            # the failed attempts may have eaten most of the watchdog
+            # budget — a short re-measure that prints SOMETHING beats
+            # the parent killing the child mid-way with no JSON at all
+            left = remaining - (time.monotonic() - child_start)
+            iters_fb = iters if left > 180 else max(4, min(iters, 16))
+            elapsed, iters_done, n_resident = _measure(
+                kernel, T_used, C, iters_fb, include_h2d
+            )
 
     channel_samples = T_used * C * iters_done
     value = channel_samples / elapsed
@@ -701,6 +733,8 @@ def _child() -> None:
             result["hbm_frac"] = round(hbm / peak_hbm, 4)
     if pallas_error is not None:
         result["pallas_error"] = pallas_error
+    if pallas_impl is not None:
+        result["pallas_impl"] = pallas_impl
     if n_resident == 1:
         result["warning"] = (
             "single resident window: the scan body is loop-invariant "

@@ -118,8 +118,9 @@ class TestCompareVisibility:
         def boom(*a, **k):
             raise RuntimeError("mosaic compile failure (synthetic)")
 
+        monkeypatch.delenv("TPUDAS_PALLAS_IMPL", raising=False)
         fir_mod._layout_for.cache_clear()
-        fir_mod._build_cascade_fn.cache_clear()
+        fir_mod._clear_cascade_caches()
         monkeypatch.setattr(fir_mod, "_pallas_stage_ok", lambda *a: True)
         monkeypatch.setattr(pf_mod, "fir_decimate_pallas", boom)
         try:
@@ -129,10 +130,44 @@ class TestCompareVisibility:
             )
         finally:
             fir_mod._layout_for.cache_clear()
-            fir_mod._build_cascade_fn.cache_clear()
+            fir_mod._clear_cascade_caches()
         assert result["value"] > 0
         assert result["engine"] == "cascade"
         assert "mosaic compile failure" in result["pallas_error"]
+
+    def test_pallas_v2_failure_lands_on_v1(self, monkeypatch, capsys):
+        """When only the v2 kernel body fails, the bench headline runs
+        on the v1 Pallas implementation, not the XLA downgrade."""
+        import tpudas.ops.fir as fir_mod
+        import tpudas.ops.pallas_fir as pf_mod
+
+        def boom(*a, **k):
+            raise RuntimeError("v2 body rejected (synthetic)")
+
+        monkeypatch.delenv("TPUDAS_PALLAS_IMPL", raising=False)
+        fir_mod._layout_for.cache_clear()
+        fir_mod._clear_cascade_caches()
+        # admit only the full-rate stage: forcing EVERY stage onto
+        # Pallas makes the 512-frame grid rounding inflate the chain
+        # by orders of magnitude at this tiny T, and interpret mode
+        # walks those grid cells in Python
+        monkeypatch.setattr(
+            fir_mod, "_pallas_stage_ok",
+            lambda k, R, n_ch, B: k >= 3000 and B <= 128,
+        )
+        monkeypatch.setattr(pf_mod, "_kernel_body", boom)
+        try:
+            result = _run_child(
+                monkeypatch, capsys, BENCH_PALLAS="1", BENCH_COMPARE="0",
+                BENCH_QUANT="0", BENCH_REMAINING="100000",
+            )
+        finally:
+            fir_mod._layout_for.cache_clear()
+            fir_mod._clear_cascade_caches()
+        assert result["value"] > 0
+        assert result["engine"] == "cascade-pallas"
+        assert result["pallas_impl"] == "v1"
+        assert "v2 body rejected" in result["pallas_error"]
 
 
 class TestE2EChild:

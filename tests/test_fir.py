@@ -198,6 +198,33 @@ class TestPallasKernel:
         scale = np.abs(exact).max()
         assert np.abs(got - exact).max() < 1e-4 * scale
 
+    def test_v1_impl_matches_v2(self, monkeypatch):
+        """TPUDAS_PALLAS_IMPL=v1 (the proven-on-hardware VPU kernel)
+        agrees with the default v2 MXU kernel in interpret mode."""
+        from tpudas.ops.fir import _block_taps
+        from tpudas.ops.pallas_fir import fir_decimate_pallas
+
+        rng = np.random.default_rng(4)
+        T, C, R, L = 6000, 70, 4, 19
+        x = rng.standard_normal((T, C)).astype(np.float32)
+        hb = _block_taps(rng.standard_normal(L).astype(np.float32), R)
+        monkeypatch.delenv("TPUDAS_PALLAS_IMPL", raising=False)
+        v2 = np.asarray(
+            fir_decimate_pallas(jnp.asarray(x), hb, R, 600, interpret=True)
+        )
+        monkeypatch.setenv("TPUDAS_PALLAS_IMPL", "v1")
+        v1 = np.asarray(
+            fir_decimate_pallas(jnp.asarray(x), hb, R, 600, interpret=True)
+        )
+        scale = np.abs(v2).max()
+        assert np.abs(v1 - v2).max() < 1e-5 * scale
+        # int16 input path exists on both
+        q = rng.integers(-3000, 3000, size=(T, C)).astype(np.int16)
+        v1q = np.asarray(
+            fir_decimate_pallas(jnp.asarray(q), hb, R, 600, interpret=True)
+        )
+        assert np.isfinite(v1q).all()
+
     def test_multi_stream_grid_quantum(self):
         """n_out that is not a multiple of the 512-frame grid quantum
         still yields exact results (pad + trim path)."""
@@ -361,8 +388,9 @@ class TestPallasFallback:
         def boom(*a, **k):
             raise RuntimeError("mosaic compile failure (synthetic)")
 
+        monkeypatch.delenv("TPUDAS_PALLAS_IMPL", raising=False)
         fir_mod._layout_for.cache_clear()
-        fir_mod._build_cascade_fn.cache_clear()
+        fir_mod._clear_cascade_caches()
         monkeypatch.setattr(
             fir_mod, "resolve_cascade_engine",
             lambda e="auto": "pallas" if e == "auto" else e,
@@ -386,7 +414,7 @@ class TestPallasFallback:
             )
         finally:
             fir_mod._layout_for.cache_clear()
-            fir_mod._build_cascade_fn.cache_clear()
+            fir_mod._clear_cascade_caches()
         assert not lfp._pallas_ok
         assert lfp.engine_counts["cascade-pallas"] == 0
         assert lfp.engine_counts["cascade-xla"] == sum(
@@ -394,6 +422,63 @@ class TestPallasFallback:
         )
         assert len(list(out.iterdir())) > 0
         assert "falling back to the XLA" in capsys.readouterr().out
+
+
+    def test_lfproc_falls_back_to_v1_impl(self, tmp_path, monkeypatch,
+                                          capsys):
+        """When only the v2 kernel body fails, the engine continues on
+        the v1 implementation — still Pallas, no XLA downgrade."""
+        import tpudas.ops.fir as fir_mod
+        import tpudas.ops.pallas_fir as pf_mod
+        from tpudas import spool
+        from tpudas.proc.lfproc import LFProc
+        from tpudas.testing import make_synthetic_spool
+        from tpudas.utils.logging import set_log_handler
+
+        d = tmp_path / "raw"
+        make_synthetic_spool(
+            d, n_files=4, file_duration=30.0, fs=100.0, n_ch=6, noise=0.01
+        )
+
+        def boom(*a, **k):
+            raise RuntimeError("v2 body rejected (synthetic)")
+
+        monkeypatch.delenv("TPUDAS_PALLAS_IMPL", raising=False)
+        fir_mod._layout_for.cache_clear()
+        fir_mod._clear_cascade_caches()
+        monkeypatch.setattr(
+            fir_mod, "resolve_cascade_engine",
+            lambda e="auto": "pallas" if e == "auto" else e,
+        )
+        monkeypatch.setattr(fir_mod, "_pallas_stage_ok", lambda *a: True)
+        monkeypatch.setattr(pf_mod, "_kernel_body", boom)
+        events = []
+        set_log_handler(events.append)
+        try:
+            lfp = LFProc(spool(str(d)).sort("time").update())
+            lfp.update_processing_parameter(
+                output_sample_interval=1.0,
+                process_patch_size=60,
+                edge_buff_size=10,
+            )
+            out = tmp_path / "out"
+            lfp.set_output_folder(str(out), delete_existing=True)
+            lfp.process_time_range(
+                np.datetime64("2023-03-22T00:00:00"),
+                np.datetime64("2023-03-22T00:02:00"),
+            )
+        finally:
+            set_log_handler(None)
+            fir_mod._layout_for.cache_clear()
+            fir_mod._clear_cascade_caches()
+        assert lfp._pallas_ok  # never downgraded to XLA
+        assert lfp.engine_counts["cascade-xla"] == 0
+        assert lfp.engine_counts["cascade-pallas"] == sum(
+            lfp.engine_counts.values()
+        )
+        impls = [e for e in events if e["event"] == "pallas_impl_fallback"]
+        assert len(impls) == 1 and impls[0]["impl"] == "v1"
+        assert "continuing on the v1" in capsys.readouterr().out
 
 
 class TestLFProcEngines:

@@ -470,6 +470,25 @@ def _blocked_taps(plan: CascadePlan):
     return [(R, _block_taps(np.asarray(h), R)) for R, h in plan.stages]
 
 
+def _clear_cascade_caches():
+    """Drop every compiled-cascade cache (single-device, time-sharded,
+    window-batched) so the next call retraces — needed when the Pallas
+    implementation selector (TPUDAS_PALLAS_IMPL) changes mid-process."""
+    _build_cascade_fn.cache_clear()
+    try:
+        from tpudas.parallel.pipeline import _build_sharded_cascade_fn
+
+        _build_sharded_cascade_fn.cache_clear()
+    except Exception:
+        pass
+    try:
+        from tpudas.parallel.batch import _build_batched_cascade_fn
+
+        _build_batched_cascade_fn.cache_clear()
+    except Exception:
+        pass
+
+
 def _pallas_interpret() -> bool:
     # interpret mode off-TPU so the same code path is testable on
     # the CPU mesh (SURVEY.md §4 "distributed-without-a-cluster")

@@ -168,6 +168,84 @@ def _band_matrix(taps: tuple, R: int, SB: int, rows: int) -> np.ndarray:
     return A
 
 
+# ---------------------------------------------------------------------------
+# v1 implementation (VPU shifted multiply-reduce): the kernel behind the
+# proven 29.06 G ch-samp/s on-chip record (PERF.md §3).  Kept selectable
+# via TPUDAS_PALLAS_IMPL=v1 — and as the bench's automatic middle
+# fallback — until the v2 MXU kernel has been validated by Mosaic on
+# real hardware (it has only interpret-mode coverage; PERF.md §5).
+
+
+def _kernel_body_v1(B, KB, CB):
+    def kernel(hb_ref, xm_ref, xh_ref, out_ref):
+        full = jnp.concatenate(
+            [xm_ref[:], xh_ref[:]], axis=0
+        ).astype(jnp.float32)
+        acc = jnp.zeros((KB, CB), jnp.float32)
+        for b in range(B):
+            acc = acc + jnp.sum(
+                full[b : b + KB] * hb_ref[b][None, :, None], axis=1
+            )
+        out_ref[:] = acc
+
+    return kernel
+
+
+def _fir_decimate_pallas_v1(x, hb, R: int, n_out: int,
+                            interpret: bool = False):
+    """The round-4 session-1 kernel: 128-frame blocks, taps as a VMEM
+    operand, B shifted VPU multiply-reduces.  Accepts int16 input
+    (bare cast, like v2).  Tolerates inputs sized for the v2 grid
+    quantum (it slices its own, smaller need)."""
+    KB = CB = 128
+    hb = np.asarray(hb)
+    B = int(hb.shape[0])
+    T, C = x.shape
+    halo_f = _halo_frames(B, KB)
+    if halo_f > KB:
+        raise ValueError(
+            f"tap frames ({B}) exceed the kernel block ({KB} frames); "
+            "use the XLA polyphase path for very long stages"
+        )
+    nk = -(-int(n_out) // KB)
+    nc = -(-int(C) // CB)
+    Kpad = nk * KB
+    need_rows = (Kpad + halo_f) * R
+    pad_t = need_rows - T
+    pad_c = nc * CB - C
+    if pad_t > 0 or pad_c > 0:
+        x = jnp.pad(x, ((0, max(pad_t, 0)), (0, pad_c)))
+    xr = x[:need_rows].reshape(Kpad + halo_f, R, nc * CB)
+    hb_pad = np.zeros((halo_f, R), np.float32)
+    hb_pad[:B] = hb.astype(np.float32)
+    step = KB // halo_f
+    out = pl.pallas_call(
+        _kernel_body_v1(B, KB, CB),
+        grid=(nk, nc),
+        in_specs=[
+            pl.BlockSpec(
+                (halo_f, R), lambda k, c: (0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (KB, R, CB),
+                lambda k, c: (k, 0, c),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (halo_f, R, CB),
+                lambda k, c, _s=step: (k * _s + _s, 0, c),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (KB, CB), lambda k, c: (k, c), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((Kpad, nc * CB), jnp.float32),
+        interpret=interpret,
+    )(jnp.asarray(hb_pad), xr, xr)
+    return out[:n_out, :C]
+
+
 def fir_decimate_pallas(
     x, hb, R: int, n_out: int, interpret: bool = False, kb=_KB, cb=_CB
 ):
@@ -190,7 +268,12 @@ def fir_decimate_pallas(
     this stage's (decimated, so R-times smaller) output.  Keeping the
     scale out of the kernel keeps it a traced value: one compiled
     executable serves every scale.
+
+    ``TPUDAS_PALLAS_IMPL=v1`` selects the previous VPU formulation
+    (the proven-on-hardware kernel; see the v1 section below).
     """
+    if os.environ.get("TPUDAS_PALLAS_IMPL", "v2") == "v1":
+        return _fir_decimate_pallas_v1(x, hb, R, n_out, interpret)
     B = int(hb.shape[0])
     T, C = x.shape
     KB, CB = int(kb), int(cb)

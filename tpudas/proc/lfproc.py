@@ -896,9 +896,10 @@ class LFProc:
                     self._pallas_proven.add(shape_key)
             except Exception as exc:
                 # a compile failure of the Pallas fast path must not
-                # kill the run: permanently fall back to the XLA
-                # formulation (same numerics) and say so.  Only a
-                # not-yet-proven window shape qualifies — once the
+                # kill the run: try the v1 (proven-on-hardware) kernel
+                # implementation, then permanently fall back to the
+                # XLA formulation (same numerics) — and say so.  Only
+                # a not-yet-proven window shape qualifies — once the
                 # kernel has executed for this shape, a later failure
                 # is not a compile problem and must propagate.  Device
                 # (HBM) exhaustion also propagates — XLA would OOM on
@@ -916,15 +917,43 @@ class LFProc:
                     or hbm_oom
                 ):
                     raise
-                self._pallas_ok = False
-                print(
-                    "Warning: Pallas kernel failed on this backend "
-                    f"({str(exc)[:120]}); falling back to the XLA "
-                    "cascade for the rest of the run"
-                )
-                log_event("pallas_fallback", error=str(exc)[:300])
-                ran = "cascade-xla"
-                out = _run_cascade("xla")
+                out = None
+                # an EXPLICIT TPUDAS_PALLAS_IMPL is the operator's
+                # choice (either value) and is never overridden; only
+                # the unset default may auto-switch — process-wide by
+                # design, since the v2 kernel is broken on this
+                # backend for every in-process user alike
+                if "TPUDAS_PALLAS_IMPL" not in os.environ:
+                    from tpudas.ops.fir import _clear_cascade_caches
+
+                    os.environ["TPUDAS_PALLAS_IMPL"] = "v1"
+                    _clear_cascade_caches()
+                    try:
+                        out = _run_cascade(eng_req)
+                        self._pallas_proven.add(shape_key)
+                        print(
+                            "Warning: Pallas v2 kernel failed "
+                            f"({msg[:120]}); continuing on the v1 "
+                            "kernel implementation"
+                        )
+                        log_event(
+                            "pallas_impl_fallback", impl="v1",
+                            error=msg[:300],
+                        )
+                    except Exception as exc2:
+                        msg += " | v1: " + str(exc2)[:200]
+                        _clear_cascade_caches()
+                        out = None
+                if out is None:
+                    self._pallas_ok = False
+                    print(
+                        "Warning: Pallas kernel failed on this backend "
+                        f"({msg[:120]}); falling back to the XLA "
+                        "cascade for the rest of the run"
+                    )
+                    log_event("pallas_fallback", error=msg[:300])
+                    ran = "cascade-xla"
+                    out = _run_cascade("xla")
         else:
             idx, w = interp_indices_weights(taxis, target_times)
             data = host32
