@@ -39,6 +39,11 @@ def main():
     )
     ap.add_argument("--fs", type=float, default=500.0)
     ap.add_argument("--n-ch", type=int, default=64)
+    ap.add_argument(
+        "--window-dp", action="store_true",
+        help="batch windows over the mesh time axis (window-level "
+        "data parallelism) instead of sharding inside each window",
+    )
     args = ap.parse_args()
 
     n_dev = device_count()
@@ -71,6 +76,7 @@ def main():
             output_sample_interval=1.0,
             process_patch_size=60,
             edge_buff_size=10,
+            window_dp=bool(args.window_dp and m is not None),
         )
         out = os.path.join(workdir, label.replace("-", "_"))
         lfp.set_output_folder(out, delete_existing=True)
