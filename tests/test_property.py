@@ -63,6 +63,46 @@ class TestScheduleProperties:
         assert emitted == sorted(set(emitted)), "overlap or disorder"
 
 
+class TestCascadeDesignProperties:
+    @settings(max_examples=40, deadline=None)
+    @given(
+        ratio=st.sampled_from(
+            [2, 4, 5, 8, 10, 20, 25, 40, 50, 100, 125, 200, 400, 500,
+             1000, 2000]
+        ),
+        fs_exp=st.floats(1.7, 3.3),  # fs in ~[50, 2000] Hz
+        corner_frac=st.floats(0.2, 0.45),
+    )
+    def test_response_matches_butter2_across_design_space(
+        self, ratio, fs_exp, corner_frac
+    ):
+        """The cascade's engine-parity contract — composite magnitude
+        equals the Butterworth-squared target on the retained band to
+        ~1e-4 — holds across the whole (fs, ratio, corner) space the
+        engine can be configured with, not just the three hand-picked
+        test points."""
+        from tpudas.ops.fir import (
+            butter2_mag,
+            design_cascade,
+            impulse_response,
+        )
+
+        fs = 10.0 ** fs_exp
+        corner = corner_frac * fs / ratio
+        plan = design_cascade(fs, ratio, corner, 4)
+        h = impulse_response(plan)
+        nfft = max(1 << 16, 1 << int(np.ceil(np.log2(len(h) * 4))))
+        H = np.abs(np.fft.rfft(h, nfft))
+        freqs = np.arange(nfft // 2 + 1) / nfft * fs
+        band = freqs <= 0.5 * fs / ratio
+        err = np.abs(H[band] - butter2_mag(freqs[band], corner, 4))
+        assert err.max() < 2e-4, (fs, ratio, corner, err.max())
+        # zero-phase contract: integer composite delay, symmetric h
+        d = plan.delay
+        w = min(d, len(h) - 1 - d)
+        assert np.abs(h[d - w : d] - h[d + 1 : d + 1 + w][::-1]).max() < 1e-10
+
+
 class TestNamingProperties:
     @settings(max_examples=200, deadline=None)
     @given(ms=st.integers(0, 4_102_444_800_000))  # epoch .. 2100-01-01
