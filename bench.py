@@ -682,6 +682,9 @@ def _child() -> None:
                 pallas_impl = "v1"
             except Exception as exc2:
                 pallas_error += " | v1: " + str(exc2)[:200]
+                # v1 failed too: restore the unset default so other
+                # in-process callers don't route to a known-bad impl
+                os.environ.pop("TPUDAS_PALLAS_IMPL", None)
                 _fir._clear_cascade_caches()
                 elapsed = None
         if elapsed is None:

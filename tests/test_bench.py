@@ -129,6 +129,7 @@ class TestCompareVisibility:
                 BENCH_QUANT="0",
             )
         finally:
+            os.environ.pop("TPUDAS_PALLAS_IMPL", None)
             fir_mod._layout_for.cache_clear()
             fir_mod._clear_cascade_caches()
         assert result["value"] > 0
@@ -162,6 +163,7 @@ class TestCompareVisibility:
                 BENCH_QUANT="0", BENCH_REMAINING="100000",
             )
         finally:
+            os.environ.pop("TPUDAS_PALLAS_IMPL", None)
             fir_mod._layout_for.cache_clear()
             fir_mod._clear_cascade_caches()
         assert result["value"] > 0

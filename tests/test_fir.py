@@ -3,6 +3,8 @@ design-response match to the reference's Butterworth-squared filter,
 XLA/Pallas agreement, and LFProc engine equivalence (SURVEY.md §4:
 filter kernel vs golden outputs, tolerance-based)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -368,6 +370,92 @@ class TestStageEngines:
 
 
 class TestPallasFallback:
+    def test_lfproc_catches_silently_wrong_pallas_numbers(
+        self, tmp_path, monkeypatch, capsys
+    ):
+        """A Mosaic miscompile that RETURNS (no exception) wrong
+        numbers is caught by the first-window cross-check against the
+        XLA formulation and handled exactly like a compile failure:
+        the run completes on the XLA cascade with correct output."""
+        import tpudas.ops.fir as fir_mod
+        import tpudas.ops.pallas_fir as pf_mod
+        from tpudas import spool
+        from tpudas.proc.lfproc import LFProc
+        from tpudas.testing import make_synthetic_spool
+        from tpudas.utils.logging import set_log_handler
+
+        d = tmp_path / "raw"
+        make_synthetic_spool(
+            d, n_files=4, file_duration=30.0, fs=100.0, n_ch=6, noise=0.01
+        )
+
+        real = pf_mod.fir_decimate_pallas
+
+        def corrupt(x, hb, R, n_out, **kw):
+            # silently wrong: scaled output, nothing raised (covers
+            # both impls, so the v1 retry is caught by the same check)
+            return real(x, hb, R, n_out=n_out, **kw) * 1.7
+
+        monkeypatch.delenv("TPUDAS_PALLAS_IMPL", raising=False)
+        fir_mod._layout_for.cache_clear()
+        fir_mod._clear_cascade_caches()
+        monkeypatch.setattr(
+            fir_mod, "resolve_cascade_engine",
+            lambda e="auto": "pallas" if e == "auto" else e,
+        )
+        monkeypatch.setattr(fir_mod, "_pallas_stage_ok", lambda *a: True)
+        monkeypatch.setattr(pf_mod, "fir_decimate_pallas", corrupt)
+        events = []
+        set_log_handler(events.append)
+        try:
+            lfp = LFProc(spool(str(d)).sort("time").update())
+            lfp.update_processing_parameter(
+                output_sample_interval=1.0,
+                process_patch_size=60,
+                edge_buff_size=10,
+            )
+            out = tmp_path / "out"
+            lfp.set_output_folder(str(out), delete_existing=True)
+            lfp.process_time_range(
+                np.datetime64("2023-03-22T00:00:00"),
+                np.datetime64("2023-03-22T00:02:00"),
+            )
+        finally:
+            os.environ.pop("TPUDAS_PALLAS_IMPL", None)
+            set_log_handler(None)
+            fir_mod._layout_for.cache_clear()
+            fir_mod._clear_cascade_caches()
+        assert not lfp._pallas_ok
+        assert lfp.engine_counts["cascade-pallas"] == 0
+        assert lfp.engine_counts["cascade-xla"] == sum(
+            lfp.engine_counts.values()
+        )
+        falls = [e for e in events if e["event"] == "pallas_fallback"]
+        assert len(falls) == 1
+        assert "pallas-vs-xla rel err" in falls[0]["error"]
+        # and the emitted output is the CORRECT numbers: re-run on a
+        # clean processor (no corruption monkeypatch active on its
+        # windows' engine choice would matter — it lands on XLA the
+        # same way) and require byte-identical files
+        lfp2 = LFProc(spool(str(d)).sort("time").update())
+        lfp2.update_processing_parameter(
+            output_sample_interval=1.0,
+            process_patch_size=60,
+            edge_buff_size=10,
+        )
+        out2 = tmp_path / "out2"
+        lfp2.set_output_folder(str(out2), delete_existing=True)
+        lfp2.process_time_range(
+            np.datetime64("2023-03-22T00:00:00"),
+            np.datetime64("2023-03-22T00:02:00"),
+        )
+        import filecmp
+
+        files = sorted(p.name for p in out.iterdir())
+        assert files == sorted(p.name for p in out2.iterdir())
+        for name in files:
+            assert filecmp.cmp(out / name, out2 / name, shallow=False)
+
     def test_lfproc_survives_pallas_compile_failure(
         self, tmp_path, monkeypatch, capsys
     ):
@@ -413,6 +501,7 @@ class TestPallasFallback:
                 np.datetime64("2023-03-22T00:02:00"),
             )
         finally:
+            os.environ.pop("TPUDAS_PALLAS_IMPL", None)
             fir_mod._layout_for.cache_clear()
             fir_mod._clear_cascade_caches()
         assert not lfp._pallas_ok
@@ -468,6 +557,7 @@ class TestPallasFallback:
                 np.datetime64("2023-03-22T00:02:00"),
             )
         finally:
+            os.environ.pop("TPUDAS_PALLAS_IMPL", None)
             set_log_handler(None)
             fir_mod._layout_for.cache_clear()
             fir_mod._clear_cascade_caches()

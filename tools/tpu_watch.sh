@@ -1,0 +1,21 @@
+#!/bin/bash
+# Poll the tunneled TPU backend until it answers; exit 0 when alive.
+# Each probe is a fresh subprocess with a hard timeout so a wedged
+# backend init can never hang the watcher itself.
+for i in $(seq 1 70); do
+  if timeout 120 python -c "
+import jax
+assert jax.default_backend() != 'cpu'
+import jax.numpy as jnp
+x = jnp.ones((128, 128))
+assert float((x @ x).sum()) == 128.0 * 128 * 128
+print('TPU ALIVE:', jax.devices())
+" 2>/dev/null; then
+    echo "tpu came up on probe $i at $(date -u +%H:%M:%S)"
+    exit 0
+  fi
+  echo "probe $i: backend unresponsive at $(date -u +%H:%M:%S)"
+  sleep 600
+done
+echo "gave up"
+exit 1

@@ -28,6 +28,7 @@ import functools
 import os
 import shutil
 import time
+import traceback
 from concurrent.futures import ThreadPoolExecutor
 
 import jax
@@ -47,7 +48,44 @@ from tpudas.ops.resample import interp_indices_weights
 from tpudas.proc.naming import get_filename
 from tpudas.utils.logging import log_event
 
-__all__ = ["LFProc", "check_merge", "schedule_windows", "lowpass_resample"]
+__all__ = ["LFProc", "PallasVerificationError", "check_merge",
+           "schedule_windows", "lowpass_resample"]
+
+# first-window cross-check tolerance: the v2 kernel's 3-pass bf16 dot
+# splits land ~1e-5 from the f32 XLA formulation (PERF.md §4) and the
+# cascade's design tolerance is 1e-4; a Mosaic miscompile produces
+# garbage, not 1e-3-level error, so 1e-3 separates the two cleanly
+_PALLAS_VERIFY_TOL = 1e-3
+
+
+class PallasVerificationError(RuntimeError):
+    """The Pallas kernel compiled but its first-window output disagrees
+    with the XLA formulation beyond tolerance — treated exactly like a
+    compile failure by the engine fallback chain."""
+
+
+def _pallas_crosscheck(got, ref, what):
+    """Raise :class:`PallasVerificationError` if ``got`` disagrees with
+    the XLA reference beyond ``_PALLAS_VERIFY_TOL``; returns the error.
+
+    Normalized PER CHANNEL (time axis 0): every channel flows through
+    the FIR independently, so the kernel's bf16 error scales with each
+    channel's own amplitude — and corruption of a quiet channel must
+    not pass under a loud channel's peak.  Dead/near-zero channels are
+    floored at 1e-7 of the window scale so roundoff on silence does
+    not false-positive while O(window-scale) garbage still trips."""
+    got = np.asarray(got)
+    ref = np.asarray(ref)
+    err_c = np.abs(got - ref).max(axis=0)
+    scale_c = np.abs(ref).max(axis=0)
+    floor = max(float(scale_c.max()) * 1e-7, 1e-30)
+    rel = float((err_c / np.maximum(scale_c, floor)).max())
+    if not np.isfinite(rel) or rel > _PALLAS_VERIFY_TOL:
+        raise PallasVerificationError(
+            f"{what} pallas-vs-xla rel err {rel:.2e} exceeds "
+            f"{_PALLAS_VERIFY_TOL:g}"
+        )
+    return rel
 
 
 def check_merge(plist):
@@ -194,10 +232,20 @@ class LFProc:
         # and propagates.
         self._pallas_ok = True
         self._pallas_proven = set()
+        # cross-check the first Pallas window of each shape against the
+        # XLA formulation (off: TPUDAS_PALLAS_VERIFY=0) — a Mosaic
+        # miscompile returning silently wrong numbers must not ship
+        self._pallas_verify = (
+            os.environ.get("TPUDAS_PALLAS_VERIFY", "1") != "0"
+        )
         # latches False after a window-DP batch-compute failure: the
         # rest of the run executes per-window instead of paying a
         # doomed stack transfer on every batch
         self._window_dp_ok = True
+        self._dp_proven = set()  # DP keys whose batched kernel passed
+        self._dp_bad = set()  # (key, impl) pairs whose batched pallas
+        # run failed the first-batch cross-check (kept per-window while
+        # that implementation is the active one)
 
     # configuration ----------------------------------------------------
     def _default_process_parameters(self):
@@ -546,6 +594,13 @@ class LFProc:
             plan, phase, int(target_times.size), host.shape,
             str(host.dtype), qs,
         )
+        impl = os.environ.get("TPUDAS_PALLAS_IMPL", "v2")
+        if (key, impl) in self._dp_bad and self._pallas_ok:
+            # this key's batched pallas lowering failed the numeric
+            # cross-check under the CURRENT implementation: keep it
+            # per-window while that implementation is in play
+            # (batching resumes under a v1 auto-switch or XLA latch)
+            return None
         return {"key": key, "host": host, "plan": plan, "phase": phase,
                 "n_out": int(target_times.size), "qs": qs}
 
@@ -593,6 +648,32 @@ class LFProc:
             )
             t_dev = time.perf_counter() - t0
             self.timings["device_s"] += t_dev
+            key = infos[0]["key"]
+            if (
+                self._pallas_verify
+                and ran == "cascade-pallas"
+                and key not in self._dp_proven
+            ):
+                # the batched kernel is a different lowering (extra
+                # window axis) than the per-window path, so it gets its
+                # own first-batch cross-check: window 0 of the batch vs
+                # the unbatched XLA formulation.  A mismatch raises
+                # into flush()'s handler, which degrades to the
+                # per-window path (whose own fallback chain then runs).
+                from tpudas.ops.fir import cascade_decimate
+
+                # mesh=mesh: the reference must shard channels the same
+                # way the per-window path does, or window 0 of a wide
+                # (north-star-scale) config lands whole on one device
+                # and OOMs — which the generic handler would misread as
+                # a batch-compute failure
+                ref = cascade_decimate(
+                    stack[0], plan, phase, n_out, "xla", mesh=mesh,
+                    qscale=qs,
+                )
+                rel = _pallas_crosscheck(out[0], ref, "window-DP batch")
+                log_event("pallas_crosscheck_dp", rel_err=rel)
+                self._dp_proven.add(key)
             return out, ran, int(stack.shape[1]), t_dev
 
         def flush():
@@ -605,6 +686,32 @@ class LFProc:
                 return
             try:
                 out, ran, rows, t_dev = run_batch()
+            except PallasVerificationError as exc:
+                # only the pallas engine is invalidated, not batching:
+                # mark (key, impl) so this key is never re-batched
+                # under the implementation that just failed, then
+                # resolve the engine on the per-window path (its own
+                # v1→XLA chain).  Later batches still batch — under
+                # v1 after an auto-switch (re-verified on first batch)
+                # or under XLA after a full latch.
+                self._dp_bad.add((
+                    pending[0][2]["key"],
+                    os.environ.get("TPUDAS_PALLAS_IMPL", "v2"),
+                ))
+                print(
+                    "Warning: window-DP batch numerics failed "
+                    f"cross-check ({str(exc)[:120]}); resolving this "
+                    "batch per-window"
+                )
+                log_event(
+                    "window_dp_crosscheck_fail", error=str(exc)[:300]
+                )
+                for patch, emit_times, _ in pending:
+                    self._process_window(
+                        patch, emit_times, dt, corner, order
+                    )
+                pending.clear()
+                return
             except Exception as exc:
                 # a batch-COMPUTE failure degrades to the per-window
                 # path, which has its own (shape-keyed) fallback — and
@@ -890,8 +997,37 @@ class LFProc:
                 int(host.shape[1]), time_layout is not None,
                 str(host.dtype),  # int16 vs f32 payloads compile apart
             )
+
+            ref_box = {}  # XLA reference, computed at most once per
+            # window and reused by the v1 retry and the final fallback
+
+            def _run_checked(eng):
+                o = _run_cascade(eng)
+                if (
+                    self._pallas_verify
+                    and ran == "cascade-pallas"
+                    and shape_key not in self._pallas_proven
+                ):
+                    # first window of an unproven shape: cross-check
+                    # the Pallas output against the XLA formulation on
+                    # the SAME window.  The fallback chain only fires
+                    # on raised exceptions; a Mosaic miscompile that
+                    # returns silently wrong numbers must not ship
+                    # through LFProc undetected.  Costs one extra XLA
+                    # run on the first window of each shape.
+                    if "ref" not in ref_box:
+                        ref_box["ref"] = np.asarray(_run_cascade("xla"))
+                    rel = _pallas_crosscheck(
+                        o, ref_box["ref"], "first window"
+                    )
+                    log_event(
+                        "pallas_crosscheck", rel_err=rel,
+                        shape=list(host.shape),
+                    )
+                return o
+
             try:
-                out = _run_cascade(eng_req)
+                out = _run_checked(eng_req)
                 if ran == "cascade-pallas":
                     self._pallas_proven.add(shape_key)
             except Exception as exc:
@@ -907,6 +1043,15 @@ class LFProc:
                 # kernel-formulation failure the fallback absorbs (the
                 # XLA path tiles through HBM instead of VMEM).
                 msg = str(exc)
+                # the blanket except is deliberate (compile failures
+                # surface as many exception types across jax versions)
+                # but must stay diagnosable: the full traceback goes to
+                # the event log so a masked non-Pallas bug can still be
+                # found
+                log_event(
+                    "pallas_error_detail",
+                    traceback=traceback.format_exc(),
+                )
                 hbm_oom = (
                     "RESOURCE_EXHAUSTED" in msg
                     and "vmem" not in msg.lower()
@@ -929,7 +1074,7 @@ class LFProc:
                     os.environ["TPUDAS_PALLAS_IMPL"] = "v1"
                     _clear_cascade_caches()
                     try:
-                        out = _run_cascade(eng_req)
+                        out = _run_checked(eng_req)
                         self._pallas_proven.add(shape_key)
                         print(
                             "Warning: Pallas v2 kernel failed "
@@ -942,6 +1087,10 @@ class LFProc:
                         )
                     except Exception as exc2:
                         msg += " | v1: " + str(exc2)[:200]
+                        # v1 just failed too: leaving the env var set
+                        # would route other in-process callers of the
+                        # kernel to a known-failing implementation
+                        os.environ.pop("TPUDAS_PALLAS_IMPL", None)
                         _clear_cascade_caches()
                         out = None
                 if out is None:
@@ -953,7 +1102,11 @@ class LFProc:
                     )
                     log_event("pallas_fallback", error=msg[:300])
                     ran = "cascade-xla"
-                    out = _run_cascade("xla")
+                    # a verification failure already computed the XLA
+                    # result for this window — emit it, don't recompute
+                    out = ref_box.get("ref")
+                    if out is None:
+                        out = _run_cascade("xla")
         else:
             idx, w = interp_indices_weights(taxis, target_times)
             data = host32
