@@ -74,6 +74,7 @@ class TestQueries:
         assert len(dist.global_mesh_devices()) == len(jax.devices())
 
 
+@pytest.mark.slow
 class TestRealTwoProcessDCN:
     def test_two_process_mesh_collectives(self):
         """The real thing, no mocks: two spawned processes call

@@ -16,6 +16,7 @@ def test_entry_jits_and_runs():
     assert np.isfinite(out).all()
 
 
+@pytest.mark.slow  # the round driver exercises this path on every run
 @pytest.mark.parametrize("n", [4, 8])
 def test_dryrun_multichip(n):
     # no device-count gate: the dryrun spawns its own clean-env child

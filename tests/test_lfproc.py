@@ -312,7 +312,7 @@ class TestEndToEnd:
         )
         make_synthetic_spool(
             d, n_files=2, file_duration=30.0, fs=FS, n_ch=4, noise=0.0,
-            start="2023-03-22T00:02:00",
+            start="2023-03-22T00:02:00", prefix="late",
         )
         lfp = LFProc(spool(str(d)).sort("time").update())
         lfp.update_processing_parameter(
@@ -488,6 +488,7 @@ class TestEndToEnd:
                 np.datetime64("2023-03-22T00:02:00"),
             )
 
+    @pytest.mark.slow
     def test_10k_channel_window_config4_shapes(self, tmp_path):
         """BASELINE config 4 shapes on CPU: one overlap-save window of a
         10,000-channel 1 kHz stream through schedule_windows ->
@@ -538,7 +539,7 @@ class TestEndToEnd:
         make_synthetic_spool(d, n_files=1, file_duration=30.0, fs=FS, n_ch=4)
         make_synthetic_spool(
             d, n_files=1, file_duration=30.0, fs=FS, n_ch=4,
-            start="2023-03-22T00:02:00",
+            start="2023-03-22T00:02:00", prefix="late",
         )
         lfp = LFProc(spool(str(d)).sort("time").update())
         lfp.update_processing_parameter(
@@ -551,3 +552,112 @@ class TestEndToEnd:
                 np.datetime64("2023-03-22T00:00:00"),
                 np.datetime64("2023-03-22T00:03:00"),
             )
+
+
+class TestGapTolerance:
+    """data_gap_tolorance's single meaning (the key the reference
+    declares but never reads, lf_das.py:202): a hole of at most that
+    many seconds between consecutive files is NOT a gap — the window
+    merge bridges it by linear interpolation — while anything wider is
+    a gap handled per on_gap."""
+
+    def _gappy_spool(self, d, hole_s):
+        # 2 files, a hole, 2 more files (contiguous inside each half)
+        make_synthetic_spool(
+            d, n_files=2, file_duration=30.0, fs=FS, n_ch=4, noise=0.0
+        )
+        t2 = np.datetime64("2023-03-22T00:01:00") + np.timedelta64(
+            int(hole_s * 1e9), "ns"
+        )
+        make_synthetic_spool(
+            d, n_files=2, file_duration=30.0, fs=FS, n_ch=4, noise=0.0,
+            start=str(t2), prefix="late",
+        )
+
+    def test_sub_tolerance_hole_is_filled_not_raised(self, tmp_path):
+        from tpudas.utils.logging import set_log_handler
+
+        d = tmp_path / "gappy"
+        self._gappy_spool(d, hole_s=5.0)  # < default tolerance 10 s
+        lfp = LFProc(spool(str(d)).sort("time").update())
+        lfp.update_processing_parameter(
+            output_sample_interval=DT_OUT, process_patch_size=40,
+            edge_buff_size=5,  # on_gap stays "raise" (the default)
+        )
+        out = tmp_path / "out"
+        lfp.set_output_folder(str(out), delete_existing=True)
+        events = []
+        set_log_handler(events.append)
+        try:
+            lfp.process_time_range(
+                np.datetime64("2023-03-22T00:00:00"),
+                np.datetime64("2023-03-22T00:02:00"),
+            )
+        finally:
+            set_log_handler(None)
+        merged = spool(str(out)).update().chunk(time=None)
+        assert len(merged) == 1  # contiguous output across the hole
+        assert any(e["event"] == "gap_filled" for e in events)
+
+    def test_tolerance_zero_restores_strict_raise(self, tmp_path):
+        d = tmp_path / "gappy0"
+        self._gappy_spool(d, hole_s=5.0)
+        lfp = LFProc(spool(str(d)).sort("time").update())
+        lfp.update_processing_parameter(
+            output_sample_interval=DT_OUT, process_patch_size=40,
+            edge_buff_size=5, data_gap_tolorance=0.0,
+        )
+        lfp.set_output_folder(str(tmp_path / "out"), delete_existing=True)
+        with pytest.raises(Exception, match="Gap in data exists"):
+            lfp.process_time_range(
+                np.datetime64("2023-03-22T00:00:00"),
+                np.datetime64("2023-03-22T00:02:00"),
+            )
+
+    def test_wider_than_tolerance_hole_still_raises(self, tmp_path):
+        d = tmp_path / "gappy2"
+        self._gappy_spool(d, hole_s=30.0)  # > default tolerance 10 s
+        lfp = LFProc(spool(str(d)).sort("time").update())
+        lfp.update_processing_parameter(
+            output_sample_interval=DT_OUT, process_patch_size=40,
+            edge_buff_size=5,
+        )
+        lfp.set_output_folder(str(tmp_path / "out"), delete_existing=True)
+        with pytest.raises(Exception, match="Gap in data exists"):
+            lfp.process_time_range(
+                np.datetime64("2023-03-22T00:00:00"),
+                np.datetime64("2023-03-22T00:02:30"),
+            )
+
+    def test_merge_fill_values_are_linear(self):
+        from tpudas.core.patch import Patch
+        from tpudas.io.spool import merge_patches
+
+        def mk(t0, vals):
+            vals = np.asarray(vals, dtype=np.float32)[:, None]
+            times = np.datetime64(t0, "ns") + np.arange(
+                len(vals)
+            ) * np.timedelta64(100_000_000, "ns")  # 10 Hz
+            return Patch(
+                data=vals,
+                coords={"time": times, "distance": np.array([0.0])},
+                dims=("time", "distance"),
+                attrs={"d_time": 0.1, "d_distance": 1.0},
+            )
+
+        a = mk("2023-01-01T00:00:00", [0.0, 1.0, 2.0])
+        # hole of 3 missing samples: last a-sample at 0.2 s, b starts
+        # at 0.6 s -> fills at 0.3/0.4/0.5 s, linear from 2.0 to 6.0
+        b = mk("2023-01-01T00:00:00.6", [6.0, 7.0])
+        out = merge_patches([a, b], max_fill=1.0)
+        assert len(out) == 1
+        got = out[0].host_data()[:, 0]
+        np.testing.assert_allclose(
+            got, [0, 1, 2, 3, 4, 5, 6, 7], rtol=1e-6
+        )
+        # off-grid hole (not a multiple of the step): NOT filled
+        c = mk("2023-01-01T00:00:00.65", [6.0, 7.0])
+        assert len(merge_patches([a, c], max_fill=1.0)) == 2
+        # hole longer than max_fill: NOT filled
+        d = mk("2023-01-01T00:00:01.6", [6.0, 7.0])
+        assert len(merge_patches([a, d], max_fill=1.0)) == 2

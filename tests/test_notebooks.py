@@ -8,6 +8,8 @@ import os
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 NB_DIR = os.path.join(os.path.dirname(__file__), os.pardir, "notebooks")
 
 NOTEBOOKS = [

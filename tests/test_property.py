@@ -14,6 +14,8 @@ from hypothesis import given, settings, strategies as st
 from tpudas.proc.lfproc import schedule_windows
 from tpudas.proc.naming import get_filename, get_timestr
 
+pytestmark = pytest.mark.slow
+
 
 class TestScheduleProperties:
     @settings(max_examples=200, deadline=None)
@@ -179,3 +181,115 @@ class TestTdasRoundTrip:
         # round-at-.5 boundary and the decode multiply
         bound = scale * 0.5 + np.abs(data).max() * 1e-6
         assert np.abs(back - data).max() <= bound
+
+
+class TestCrashResumeProperty:
+    """The crash-only contract (lf_das.py:214-217,
+    low_pass_dascore_edge.ipynb:228-231) fuzzed over kill points: a run
+    killed after ANY window, resumed via the output-folder state +
+    rewind, must produce the same contiguous output as an uninterrupted
+    run — not just at round granularity (the fixed tests) but at every
+    window boundary."""
+
+    FS = 100.0
+    DT = 1.0
+    BUFF = 5
+    PATCH = 40
+    T1, T2 = "2023-03-22T00:00:00", "2023-03-22T00:03:00"
+
+    @pytest.fixture(scope="class")
+    def crash_spool(self, tmp_path_factory):
+        from tpudas.testing import make_synthetic_spool
+
+        d = tmp_path_factory.mktemp("crashraw")
+        make_synthetic_spool(
+            d, n_files=6, file_duration=30.0, fs=self.FS, n_ch=4,
+            noise=0.01,
+        )
+        return str(d)
+
+    @pytest.fixture(scope="class")
+    def full_run(self, crash_spool, tmp_path_factory):
+        out = tmp_path_factory.mktemp("full") / "out"
+        self._run(crash_spool, out, self.T1, self.T2)
+        from tpudas import spool
+
+        return spool(str(out)).update().chunk(time=None)[0]
+
+    def _run(self, src, out_dir, t1, t2, crash_after=None):
+        from tpudas import spool
+        from tpudas.proc.lfproc import LFProc
+
+        lfp = LFProc(spool(src).sort("time").update())
+        lfp.update_processing_parameter(
+            output_sample_interval=self.DT,
+            process_patch_size=self.PATCH,
+            edge_buff_size=self.BUFF,
+        )
+        lfp.set_output_folder(
+            str(out_dir), delete_existing=crash_after is not None
+        )
+        if crash_after is None:
+            lfp.process_time_range(np.datetime64(t1), np.datetime64(t2))
+            return lfp
+
+        real = LFProc._emit_window_output
+        calls = {"n": 0}
+
+        def dying(self_, *a, **kw):
+            if calls["n"] >= crash_after:
+                raise KeyboardInterrupt("synthetic crash")
+            calls["n"] += 1
+            return real(self_, *a, **kw)
+
+        LFProc._emit_window_output = dying
+        try:
+            with pytest.raises(KeyboardInterrupt):
+                lfp.process_time_range(
+                    np.datetime64(t1), np.datetime64(t2)
+                )
+        finally:
+            LFProc._emit_window_output = real
+        assert calls["n"] == crash_after
+        return lfp
+
+    @settings(max_examples=8, deadline=None)
+    @given(k=st.integers(1, 7))
+    def test_kill_after_any_window_resumes_seamlessly(
+        self, k, crash_spool, full_run, tmp_path_factory
+    ):
+        from tpudas import spool
+        from tpudas.proc.lfproc import LFProc, schedule_windows
+
+        n_wins = len(schedule_windows(181, self.PATCH, self.BUFF))
+        k = min(k, n_wins - 1)  # crash strictly before the last window
+        out = tmp_path_factory.mktemp(f"crash{k}") / "out"
+        self._run(crash_spool, out, self.T1, self.T2, crash_after=k)
+
+        # resume exactly as the real-time loop does: output folder IS
+        # the state; rewind (buff-1) output steps before the last
+        # processed time
+        lfp2 = LFProc(spool(crash_spool).sort("time").update())
+        lfp2.update_processing_parameter(
+            output_sample_interval=self.DT,
+            process_patch_size=self.PATCH,
+            edge_buff_size=self.BUFF,
+        )
+        lfp2.set_output_folder(str(out), delete_existing=False)
+        t_last = lfp2.get_last_processed_time()
+        rewind = int((self.BUFF - 1) * self.DT)
+        lfp2.process_time_range(
+            t_last - np.timedelta64(rewind, "s"), np.datetime64(self.T2)
+        )
+
+        merged = spool(str(out)).update().chunk(time=None)
+        assert len(merged) == 1, "resume left a seam or a hole"
+        got = merged[0]
+        ref = full_run
+        ta, tb = got.coords["time"], ref.coords["time"]
+        lo = max(ta[0], tb[0])
+        hi = min(ta[-1], tb[-1])
+        gsel = got.select(time=(lo, hi)).host_data()
+        rsel = ref.select(time=(lo, hi)).host_data()
+        scale = np.abs(rsel).max()
+        assert np.abs(gsel - rsel).max() < 5e-3 * scale

@@ -44,6 +44,8 @@ import pytest
 
 from tpudas.testing import make_synthetic_spool
 
+pytestmark = pytest.mark.slow
+
 REF = "/root/reference"
 PATH_VARS = ("data_path", "output_data_folder", "output_figure_folder")
 

@@ -1,0 +1,93 @@
+"""Measure peak HBM per processed window across memory-model configs.
+
+Backs the ``get_patch_time`` docstring's claim (reference
+lf_das.py:90-107: ``processing_factor=5`` with safety 1.2) with device
+data: for each (rate, n_ch, patch_sec) config the probe runs one full
+cascade window exactly as LFProc dispatches it and reports the device
+allocator's peak, the raw-window bytes, and their ratio — the measured
+processing factor.  Each config runs in a fresh subprocess so the
+per-device peak counter starts clean.
+
+Run on a live chip: ``python tools/hbm_probe.py``
+One config (subprocess mode): ``python tools/hbm_probe.py <fs> <C> <sec>``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+CONFIGS = [
+    # (fs_hz, n_ch, patch_seconds) — patch_seconds chosen near the
+    # memory model's own answer for a 14000 MB budget (f32: bpe=4)
+    (1000.0, 2048, 131.0),
+    (1000.0, 2048, 262.0),
+    (1000.0, 10000, 55.0),   # BASELINE config 4 width
+    (500.0, 5000, 110.0),
+]
+
+
+def _one(fs: float, n_ch: int, sec: float) -> None:
+    import numpy as np
+
+    import jax
+
+    from tpudas.ops.fir import cascade_decimate, design_cascade
+
+    dev = jax.devices()[0]
+    plan = design_cascade(fs, int(round(fs)), 0.45, 4)
+    T = int(round(sec * fs))
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((T, n_ch)).astype(np.float32)
+    n_out = max(int(sec) - 2 * 10, 1)
+    base = (dev.memory_stats() or {}).get("peak_bytes_in_use", 0)
+    out = np.asarray(cascade_decimate(x, plan, plan.delay, n_out, "auto"))
+    stats = dev.memory_stats() or {}
+    peak = stats.get("peak_bytes_in_use", 0)
+    print(
+        json.dumps(
+            {
+                "fs": fs,
+                "n_ch": n_ch,
+                "patch_sec": sec,
+                "window_mb": round(x.nbytes / 1e6, 1),
+                "peak_hbm_mb": round(peak / 1e6, 1),
+                "baseline_mb": round(base / 1e6, 1),
+                "measured_factor": round(peak / max(x.nbytes, 1), 2),
+                "out_shape": list(out.shape),
+            }
+        ),
+        flush=True,
+    )
+
+
+def main() -> None:
+    if len(sys.argv) == 4:
+        _one(float(sys.argv[1]), int(sys.argv[2]), float(sys.argv[3]))
+        return
+    here = os.path.dirname(os.path.abspath(__file__))
+    rows = []
+    for fs, c, sec in CONFIGS:
+        r = subprocess.run(
+            [sys.executable, os.path.join(here, "hbm_probe.py"),
+             str(fs), str(c), str(sec)],
+            capture_output=True, text=True, timeout=600,
+            cwd=os.path.dirname(here),
+        )
+        line = (r.stdout.strip().splitlines() or ["{}"])[-1]
+        try:
+            rows.append(json.loads(line))
+            print(line, flush=True)
+        except json.JSONDecodeError:
+            print(f"config ({fs},{c},{sec}) failed: "
+                  f"{r.stderr.strip()[-300:]}", flush=True)
+    if rows:
+        worst = max(r["measured_factor"] for r in rows)
+        print(f"\nworst measured processing factor: {worst} "
+              "(memory model uses 5 * 1.2 safety)")
+
+
+if __name__ == "__main__":
+    main()

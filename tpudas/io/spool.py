@@ -19,6 +19,7 @@ import pandas as pd
 from tpudas.core.patch import Patch
 from tpudas.core.timeutils import to_datetime64
 from tpudas.io.index import DirectoryIndex
+from tpudas.utils.logging import log_event
 
 __all__ = ["spool", "BaseSpool", "MemorySpool", "DirectorySpool", "merge_patches"]
 
@@ -54,7 +55,25 @@ def _normalize_time_bounds(bounds):
     )
 
 
-def merge_patches(patches, tolerance=1.5):
+def _fillable_steps(gap_ns, step_ns, max_fill):
+    """Number of whole grid steps a fillable hole spans, or 0.
+
+    A hole qualifies when (a) filling is enabled, (b) it lands on the
+    sampling grid (within 0.1 step — files from one interrogator share
+    a clock, so real holes are exact multiples), and (c) the missing
+    span ``(k-1) * step`` is at most ``max_fill`` seconds.
+    """
+    if max_fill is None or step_ns <= 0:
+        return 0
+    k = int(round(gap_ns / step_ns))
+    if k < 2:
+        return 0
+    if abs(gap_ns - k * step_ns) > 0.1 * step_ns:
+        return 0
+    return k if (k - 1) * step_ns <= max_fill * 1e9 else 0
+
+
+def merge_patches(patches, tolerance=1.5, max_fill=None):
     """Merge time-sorted patches into maximal contiguous groups.
 
     Adjacent patches are contiguous when the start of the next is within
@@ -63,6 +82,13 @@ def merge_patches(patches, tolerance=1.5):
     windows) are trimmed from the incoming patch; true gaps split the
     result into multiple patches — the caller (``_check_merge``
     semantics, lf_das.py:16-20) decides whether that is an error.
+
+    ``max_fill`` (seconds, default off): holes whose missing span is at
+    most this long — and that land on the sampling grid — are bridged
+    by linear interpolation between the bounding samples instead of
+    splitting the result (event ``gap_filled``).  This is the single
+    meaning of LFProc's ``data_gap_tolorance``: separations up to the
+    tolerance are not gaps, anywhere in the pipeline.
     """
     if not patches:
         return []
@@ -82,7 +108,10 @@ def merge_patches(patches, tolerance=1.5):
                 - prev.attrs["time_max"].astype("datetime64[ns]")
             ).astype(np.int64)
         )
-        if step_ns > 0 and gap_ns <= tolerance * step_ns:
+        if step_ns > 0 and (
+            gap_ns <= tolerance * step_ns
+            or _fillable_steps(gap_ns, step_ns, max_fill)
+        ):
             groups[-1].append(p)
         else:
             groups.append([p])
@@ -91,11 +120,22 @@ def merge_patches(patches, tolerance=1.5):
         if len(group) == 1:
             out.append(group[0])
             continue
+        first = group[0]
+        ax = first.axis_of("time")
+        step = first.attrs.get("time_step")
+        step_ns = (
+            int(step.astype("timedelta64[ns]").astype(np.int64))
+            if step is not None
+            else 0
+        )
         datas = []
         times = []
         prev_end = None
+        filled_rows = 0
         for p in group:
             data = p.host_data()
+            if ax != 0:
+                data = np.moveaxis(data, ax, 0)
             taxis = p.coords["time"]
             if prev_end is not None and taxis.size and taxis[0] <= prev_end:
                 # overlap: drop duplicated leading samples
@@ -105,13 +145,40 @@ def merge_patches(patches, tolerance=1.5):
                 taxis = taxis[start:]
             if taxis.size == 0:
                 continue
+            if prev_end is not None and step_ns > 0:
+                gap_ns = int(
+                    (
+                        taxis[0].astype("datetime64[ns]")
+                        - prev_end.astype("datetime64[ns]")
+                    ).astype(np.int64)
+                )
+                k = _fillable_steps(gap_ns, step_ns, max_fill)
+                if k:
+                    # bridge the admitted hole: linear interpolation
+                    # between the bounding rows keeps the grid regular
+                    # (the LF band this pipeline extracts is unaffected
+                    # by a sub-tolerance straight-line segment)
+                    nf = k - 1
+                    a, b = datas[-1][-1], data[0]
+                    w = (np.arange(1, nf + 1, dtype=np.float64) / k
+                         ).reshape((-1,) + (1,) * (data.ndim - 1))
+                    fill = a * (1.0 - w) + b * w
+                    datas.append(fill.astype(data.dtype, copy=False))
+                    times.append(
+                        prev_end.astype("datetime64[ns]")
+                        + np.arange(1, nf + 1)
+                        * np.timedelta64(step_ns, "ns")
+                    )
+                    filled_rows += nf
             datas.append(data)
             times.append(taxis)
             prev_end = taxis[-1]
-        first = group[0]
-        ax = first.axis_of("time")
-        if ax != 0:
-            datas = [np.moveaxis(d, ax, 0) for d in datas]
+        if filled_rows:
+            log_event(
+                "gap_filled",
+                rows=filled_rows,
+                seconds=filled_rows * step_ns / 1e9,
+            )
         merged = np.concatenate(datas, axis=0)
         if ax != 0:
             merged = np.moveaxis(merged, 0, ax)
@@ -155,13 +222,18 @@ class BaseSpool:
     def select(self, time=None, distance=None):
         raise NotImplementedError
 
-    def chunk(self, time="__required__", overlap=None, tolerance=1.5):
+    def chunk(self, time="__required__", overlap=None, tolerance=1.5,
+              max_fill=None):
         """``chunk(time=None)`` merges contiguous patches along time;
         ``chunk(time=seconds)`` merges then re-splits into fixed-length
-        segments (an extension the reference leaves to DASCore)."""
+        segments (an extension the reference leaves to DASCore).
+        ``max_fill`` (seconds) bridges on-grid holes up to that long by
+        linear interpolation — see :func:`merge_patches`."""
         if time == "__required__":
             raise TypeError("chunk() requires the time keyword, e.g. time=None")
-        merged = merge_patches(self._materialize(), tolerance=tolerance)
+        merged = merge_patches(
+            self._materialize(), tolerance=tolerance, max_fill=max_fill
+        )
         if time is None:
             return MemorySpool(merged)
         seg_sec = float(time)
@@ -195,12 +267,19 @@ class BaseSpool:
 
     # the DASCore-style identity columns every contents frame carries
     # (in addition to the coordinate-range columns); absent metadata is
-    # an empty string, as in DASCore's frame
+    # an empty string, as in DASCore's frame.  The full DASCore attr
+    # set is emitted — including columns tpudas's readers never
+    # populate (cable_id etc.) — so frame-shape-sensitive notebook code
+    # sees the same columns it would under DASCore.
     _ID_COLUMNS = (
         "network",
         "station",
         "tag",
         "instrument_id",
+        "cable_id",
+        "experiment_id",
+        "data_type",
+        "data_category",
         "data_units",
         "dims",
     )

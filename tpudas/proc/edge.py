@@ -48,6 +48,12 @@ def get_edge_effect_time(
     returns the maximal one-sided support where the response exceeds
     ``max * tol``. Raises ValueError when twice the edge is at least the
     chunk length (chunk too small for the filter).
+
+    Documented divergence from the reference: when ``freq`` is not
+    passed, the reference crashes (``kargs.get("freq")`` -> None used
+    in arithmetic, lf_das.py:63,79); tpudas defaults it to 5 Hz so the
+    probe stays runnable. Pass ``freq`` explicitly for reference-exact
+    calls — every reference notebook does.
     """
     N = int(total_T / sampling_interval)
     if N < 2:

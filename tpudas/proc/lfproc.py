@@ -73,12 +73,15 @@ def _pallas_crosscheck(got, ref, what):
     channel's own amplitude — and corruption of a quiet channel must
     not pass under a loud channel's peak.  Dead/near-zero channels are
     floored at 1e-7 of the window scale so roundoff on silence does
-    not false-positive while O(window-scale) garbage still trips."""
+    not false-positive while O(window-scale) garbage still trips.  An
+    absolute floor of 1e-6 keeps an ALL-zero reference window (fiber
+    silence) from flagging denormal-level kernel roundoff as a
+    miscompile."""
     got = np.asarray(got)
     ref = np.asarray(ref)
     err_c = np.abs(got - ref).max(axis=0)
     scale_c = np.abs(ref).max(axis=0)
-    floor = max(float(scale_c.max()) * 1e-7, 1e-30)
+    floor = max(float(scale_c.max()) * 1e-7, 1e-6)
     rel = float((err_c / np.maximum(scale_c, floor)).max())
     if not np.isfinite(rel) or rel > _PALLAS_VERIFY_TOL:
         raise PallasVerificationError(
@@ -257,6 +260,14 @@ class LFProc:
             "output_sample_interval": 1.0,  # seconds
             "process_patch_size": 100,  # output samples per window
             "edge_buff_size": 10,  # output samples of trimmed halo
+            # ONE meaning everywhere (the reference declares this key
+            # but never reads it, lf_das.py:202 — tpudas implements the
+            # promise): a hole between consecutive files of at most
+            # this many seconds is NOT a gap. The window merge bridges
+            # it by linear interpolation (event "gap_filled"; harmless
+            # to the LF band this pipeline extracts), and the split
+            # planner keeps the schedule in one segment across it.
+            # Anything wider IS a gap, handled per on_gap below.
             "data_gap_tolorance": 10.0,
             # "raise": reference behavior (merge failure halts the run,
             # lf_das.py:16-20). "skip": drop windows touching a gap.
@@ -371,7 +382,15 @@ class LFProc:
                 )
                 return assemble_window_patch(plan)
         selected = self._spool.select(time=(t_lo, t_hi))
-        plist = make_spool(selected).chunk(time=None)
+        # data_gap_tolorance's single meaning (see
+        # _default_process_parameters): holes up to that many seconds
+        # are not gaps — the merge bridges them by linear interpolation
+        # (the native planner above already declined such windows, so
+        # gappy windows always take this path)
+        plist = make_spool(selected).chunk(
+            time=None,
+            max_fill=float(self._para["data_gap_tolorance"]),
+        )
         if len(plist) == 0:
             if on_gap == "raise":
                 raise Exception("patch merge failed! Gap in data exists")
@@ -878,6 +897,31 @@ class LFProc:
         host, qs = self._time_major_payload(window_patch)
         taxis = window_patch.coords["time"]
         d_sec = window_patch.get_sample_step("time")
+        # coverage invariant: every emitted grid point must lie inside
+        # the loaded data (one input step of slack for the stream-tail
+        # grid point that lands just past the final sample).  Without
+        # this, a hole whose edges align with window selection bounds
+        # slips past the merge's gap detection and the engine silently
+        # extrapolates output where there is no data.
+        slack = np.timedelta64(int(round(d_sec * 1e9)), "ns")
+        cov_lo = taxis[0].astype("datetime64[ns]") - slack
+        cov_hi = taxis[-1].astype("datetime64[ns]") + slack
+        if (
+            target_times[0].astype("datetime64[ns]") < cov_lo
+            or target_times[-1].astype("datetime64[ns]") > cov_hi
+        ):
+            log_event(
+                "window_coverage_gap",
+                data=[str(taxis[0]), str(taxis[-1])],
+                emit=[str(target_times[0]), str(target_times[-1])],
+            )
+            if self._para.get("on_gap", "raise") == "raise":
+                raise Exception("patch merge failed! Gap in data exists")
+            print(
+                "Warning: window data does not cover its output range; "
+                "skipping (on_gap)"
+            )
+            return
         engine = self._para.get("engine", "auto")
         if engine not in self._ENGINES:
             raise ValueError(
@@ -1073,6 +1117,12 @@ class LFProc:
 
                     os.environ["TPUDAS_PALLAS_IMPL"] = "v1"
                     _clear_cascade_caches()
+                    # v1 is a different lowering: everything proven
+                    # under v2 must re-verify (and a v1 failure on a
+                    # previously-proven shape must still reach the XLA
+                    # fallback instead of propagating)
+                    self._pallas_proven.clear()
+                    self._dp_proven.clear()
                     try:
                         out = _run_checked(eng_req)
                         self._pallas_proven.add(shape_key)

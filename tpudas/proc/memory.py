@@ -5,8 +5,16 @@ the processing working set — fits a memory budget:
 ``bytes/sec = rate * n_ch * bytes_per_element * processing_factor *
 safety``. On TPU the same closed form applies with the budget set to
 usable HBM (about 14000 MB on a 16 GB v5e chip); the default
-``processing_factor`` stays at the reference's 5 (input + FFT spectrum
-+ filtered + gather temps is comfortably under it in float32).
+``processing_factor`` stays at the reference's 5 — the measured
+peak-HBM-per-window table in PERF.md §7 (``tools/hbm_probe.py``)
+validates that the cascade's working set stays under it in float32.
+
+Distinct from this HBM model are LFProc's two HOST-side byte budgets,
+which cap pipelining (not correctness): ``_STAGE_MAX_BYTES`` (2 GiB —
+at most two prefetch-staged windows resident host-side) and
+``_DP_MAX_BATCH_BYTES`` (8 GiB — a window-DP batch plus its stack
+copy).  They bound extra host copies the pipeline keeps alive, so they
+are deliberately smaller than the device budget this model sizes for.
 """
 
 from __future__ import annotations
