@@ -2,6 +2,8 @@
 time-shard halo exchange, batched data parallelism — all must agree
 with the single-device kernels."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -270,6 +272,91 @@ class TestShardedCascade:
         assert len(falls) == 1, falls  # latched after the first failure
         assert sum(lfp.engine_counts.values()) == 4  # all windows done
         assert len(list(out.iterdir())) == 4
+
+    @pytest.mark.slow  # ~70 s: two full LFProc runs on the mesh
+    def test_lfproc_window_dp_crosscheck_catches_silent_corruption(
+        self, tmp_path, monkeypatch
+    ):
+        """A batched-lowering miscompile that RETURNS wrong numbers is
+        caught by the first-batch cross-check; the batch resolves
+        per-window (whose own chain lands on XLA), window-DP batching
+        itself stays enabled and later batches run under XLA — and the
+        emitted output is byte-equal to a serial run."""
+        import tpudas.ops.fir as fir_mod
+        import tpudas.ops.pallas_fir as pf_mod
+        from tpudas import spool
+        from tpudas.proc.lfproc import LFProc
+        from tpudas.testing import make_synthetic_spool
+        from tpudas.utils.logging import set_log_handler
+
+        d = tmp_path / "raw"
+        # 8 files / 4 min -> five same-key interior windows: one batch
+        # fails the cross-check, and at least one LATER batch must
+        # still run (on XLA) to prove batching was not latched off
+        make_synthetic_spool(
+            d, n_files=8, file_duration=30.0, fs=100.0, n_ch=6, noise=0.01
+        )
+
+        real = pf_mod.fir_decimate_pallas
+
+        def corrupt(x, hb, R, n_out, **kw):
+            return real(x, hb, R, n_out=n_out, **kw) * 1.7
+
+        monkeypatch.delenv("TPUDAS_PALLAS_IMPL", raising=False)
+        fir_mod._layout_for.cache_clear()
+        fir_mod._clear_cascade_caches()
+        monkeypatch.setattr(
+            fir_mod, "resolve_cascade_engine",
+            lambda e="auto": "pallas" if e == "auto" else e,
+        )
+        monkeypatch.setattr(fir_mod, "_pallas_stage_ok", lambda *a: True)
+        monkeypatch.setattr(pf_mod, "fir_decimate_pallas", corrupt)
+        events = []
+        set_log_handler(events.append)
+        try:
+            results = {}
+            for label, mesh, dp in (
+                ("dp", make_mesh(8, time_shards=2), True),
+                ("serial", None, False),
+            ):
+                lfp = LFProc(spool(str(d)).sort("time").update(), mesh=mesh)
+                lfp.update_processing_parameter(
+                    output_sample_interval=1.0,
+                    process_patch_size=60,
+                    edge_buff_size=10,
+                    window_dp=dp,
+                )
+                out = tmp_path / f"out_{label}"
+                lfp.set_output_folder(str(out), delete_existing=True)
+                lfp.process_time_range(
+                    np.datetime64("2023-03-22T00:00:00"),
+                    np.datetime64("2023-03-22T00:04:00"),
+                )
+                results[label] = (
+                    spool(str(out)).update().chunk(time=None)[0].host_data()
+                )
+                if dp:
+                    assert lfp._window_dp_ok  # batching NOT latched off
+                    assert not lfp._pallas_ok  # the engine was
+                    assert lfp.engine_counts["cascade-pallas"] == 0
+        finally:
+            os.environ.pop("TPUDAS_PALLAS_IMPL", None)
+            set_log_handler(None)
+            fir_mod._layout_for.cache_clear()
+            fir_mod._clear_cascade_caches()
+        fails = [
+            e for e in events if e["event"] == "window_dp_crosscheck_fail"
+        ]
+        assert len(fails) == 1, fails
+        assert "pallas-vs-xla rel err" in fails[0]["error"]
+        # batching continued AFTER the failure, on the XLA engine
+        later = [
+            e for e in events
+            if e["event"] == "window_dp_batch"
+            and e["engine"] == "cascade-xla"
+        ]
+        assert later, "no XLA-engine batch ran after the cross-check"
+        assert np.array_equal(results["dp"], results["serial"])
 
     def test_window_dp_custom_single_axis_mesh(self):
         """A 1-axis DP mesh (no channel axis) leaves channels

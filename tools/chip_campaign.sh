@@ -1,0 +1,56 @@
+#!/bin/bash
+# Round-5 hardware campaign (VERDICT r4 items 1-4, 8): run the full
+# on-chip validation + measurement sequence in dependency order the
+# moment the tunnel is alive, preserving every artifact as it lands —
+# the tunnel has died mid-session twice (r03, r04), so capture early,
+# capture often.  Each step has its own timeout and the campaign
+# continues past individual failures (later steps often still work).
+#
+# Usage: bash tools/chip_campaign.sh   (from the repo root)
+# Artifacts: chip_r05/*.log, BENCH_r05_midround.json (on bench success)
+
+set -u
+cd "$(dirname "$0")/.."
+OUT=chip_r05
+mkdir -p "$OUT"
+stamp() { date -u +%H:%M:%S; }
+
+echo "[$(stamp)] step 0: liveness probe"
+if ! timeout 150 python -c "
+import jax
+assert jax.default_backend() != 'cpu'
+import jax.numpy as jnp
+assert float((jnp.ones((128,128)) @ jnp.ones((128,128))).sum()) > 0
+print('alive:', jax.devices())
+" 2>&1 | tee "$OUT/probe.log"; then
+  echo "[$(stamp)] backend dead — aborting campaign"
+  exit 1
+fi
+
+echo "[$(stamp)] step 1: chip_check (Mosaic accepts v2? numerics f32+int16)"
+timeout 900 python tools/chip_check.py 2>&1 | tee "$OUT/chip_check.log"
+
+echo "[$(stamp)] step 2: stage-0 geometry sweep"
+timeout 1200 python tools/perf_stage0.py 2>&1 | tee "$OUT/perf_stage0.log"
+
+echo "[$(stamp)] step 3: full bench (headline + engines + int16 + e2e@256)"
+BENCH_PROFILE=1 timeout 1800 python bench.py 2>"$OUT/bench_stderr.log" \
+  | tee "$OUT/bench_stdout.log"
+# preserve the bench JSON immediately (r04 lost its end-of-round capture)
+LINE=$(grep -E '^\{.*"metric"' "$OUT/bench_stdout.log" | tail -1)
+if [ -n "$LINE" ] && ! echo "$LINE" | grep -q '"error"'; then
+  echo "$LINE" > BENCH_r05_midround.json
+  echo "[$(stamp)] preserved BENCH_r05_midround.json"
+else
+  echo "[$(stamp)] bench did not produce a clean JSON line"
+fi
+
+echo "[$(stamp)] step 4: e2e at north-star width (10k ch, int16 ingest)"
+BENCH_MODE=e2e BENCH_C=10000 BENCH_E2E_DTYPE=int16 BENCH_E2E_SEC=120 \
+  timeout 1800 python bench.py 2>"$OUT/e2e10k_stderr.log" \
+  | tee "$OUT/e2e10k.log"
+
+echo "[$(stamp)] step 5: peak-HBM-per-window probe (memory model)"
+timeout 1800 python tools/hbm_probe.py 2>&1 | tee "$OUT/hbm_probe.log"
+
+echo "[$(stamp)] campaign complete — logs in $OUT/"

@@ -76,10 +76,14 @@ def main() -> None:
             capture_output=True, text=True, timeout=600,
             cwd=os.path.dirname(here),
         )
-        line = (r.stdout.strip().splitlines() or ["{}"])[-1]
+        lines = r.stdout.strip().splitlines()
+        if r.returncode != 0 or not lines:
+            print(f"config ({fs},{c},{sec}) failed (rc={r.returncode}): "
+                  f"{r.stderr.strip()[-300:]}", flush=True)
+            continue
         try:
-            rows.append(json.loads(line))
-            print(line, flush=True)
+            rows.append(json.loads(lines[-1]))
+            print(lines[-1], flush=True)
         except json.JSONDecodeError:
             print(f"config ({fs},{c},{sec}) failed: "
                   f"{r.stderr.strip()[-300:]}", flush=True)
