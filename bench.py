@@ -548,6 +548,19 @@ def _e2e_child(backend: str) -> None:
         n_out = len(os.listdir(out))
 
     value = sec * fs * C / elapsed
+    samples = sec * fs * C
+    # per-phase wall seconds from LFProc's own accounting (assemble =
+    # waiting on the prefetch thread's window read+H2D staging, device
+    # = kernel dispatch through host sync, write = HDF5 output) and the
+    # rate each phase would sustain ALONE — locating the bottleneck,
+    # e.g. the dev tunnel's ~30 MB/s H2D shows up as an assemble rate
+    # far below the device rate, and the device rate is then the
+    # justified projection for hardware with local storage
+    timings = {k: round(v, 3) for k, v in lfp.timings.items()}
+    phase_rates = {
+        k.replace("_s", ""): round(samples / v, 1) if v > 0 else None
+        for k, v in lfp.timings.items()
+    }
     print(
         json.dumps(
             {
@@ -564,6 +577,8 @@ def _e2e_child(backend: str) -> None:
                 "native_windows": lfp.native_windows,
                 "engine_counts": lfp.engine_counts,
                 "output_files": n_out,
+                "timings_s": timings,
+                "phase_rates": phase_rates,
             }
         )
     )
