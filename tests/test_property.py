@@ -293,3 +293,111 @@ class TestCrashResumeProperty:
         rsel = ref.select(time=(lo, hi)).host_data()
         scale = np.abs(rsel).max()
         assert np.abs(gsel - rsel).max() < 5e-3 * scale
+
+
+class TestGapFillProperties:
+    """merge_patches(max_fill=...) over arbitrary hole layouts: output
+    is always a single regular-grid patch when every hole is on-grid
+    and under the tolerance, original samples survive byte-identical,
+    and fill rows are the linear bridge of their bounding samples."""
+
+    @settings(max_examples=60, deadline=None)
+    @given(
+        seg_lens=st.lists(st.integers(2, 40), min_size=2, max_size=5),
+        holes=st.lists(st.integers(1, 30), min_size=1, max_size=4),
+        fs=st.sampled_from([10.0, 100.0, 250.0]),
+    )
+    def test_on_grid_holes_fill_to_one_regular_patch(
+        self, seg_lens, holes, fs
+    ):
+        from tpudas.core.patch import Patch
+        from tpudas.io.spool import merge_patches
+
+        step_ns = int(round(1e9 / fs))
+        n_seg = len(seg_lens)
+        holes = (holes * n_seg)[: n_seg - 1]
+        t0 = np.datetime64("2023-01-01T00:00:00", "ns")
+        patches, cursor = [], 0
+        pos = t0
+        vals = []
+        for i, n in enumerate(seg_lens):
+            data = (
+                np.arange(cursor, cursor + n, dtype=np.float32)[:, None]
+                * np.array([1.0, -2.0], np.float32)[None, :]
+            )
+            times = pos + np.arange(n) * np.timedelta64(step_ns, "ns")
+            patches.append(
+                Patch(
+                    data=data,
+                    coords={"time": times,
+                            "distance": np.array([0.0, 5.0])},
+                    dims=("time", "distance"),
+                    attrs={"d_time": 1.0 / fs, "d_distance": 5.0},
+                )
+            )
+            vals.append(data)
+            cursor += n  # the value ramp runs on across segments
+            if i < n_seg - 1:
+                k = holes[i]  # k missing samples, on-grid
+                pos = times[-1] + (k + 1) * np.timedelta64(step_ns, "ns")
+        max_fill = (max(holes) + 1) / fs  # tolerate every hole
+        out = merge_patches(patches, max_fill=max_fill)
+        assert len(out) == 1
+        taxis = out[0].coords["time"]
+        steps = np.diff(taxis).astype("timedelta64[ns]").astype(np.int64)
+        assert (steps == step_ns).all(), "output grid not regular"
+        total = sum(seg_lens) + sum(holes)
+        assert taxis.size == total
+        merged = out[0].host_data()
+        # original samples byte-identical; fill rows linear between
+        # their bounding samples
+        idx = 0
+        for i, n in enumerate(seg_lens):
+            np.testing.assert_array_equal(
+                merged[idx : idx + n], vals[i]
+            )
+            idx += n
+            if i < n_seg - 1:
+                k = holes[i]
+                a, b = merged[idx - 1], merged[idx + k]
+                w = (np.arange(1, k + 1, dtype=np.float64) / (k + 1))[
+                    :, None
+                ]
+                np.testing.assert_allclose(
+                    merged[idx : idx + k],
+                    (a * (1 - w) + b * w).astype(np.float32),
+                    rtol=1e-6, atol=1e-7,
+                )
+                idx += k
+
+    @settings(max_examples=40, deadline=None)
+    @given(
+        k=st.integers(1, 20),
+        off_grid_ns=st.sampled_from([3_000_000, 5_000_000, -4_000_000]),
+    )
+    def test_off_grid_holes_never_fill(self, k, off_grid_ns):
+        """A hole that does not land on the sampling grid (within 0.1
+        step) must split, never fabricate a shifted axis."""
+        from tpudas.core.patch import Patch
+        from tpudas.io.spool import merge_patches
+
+        fs = 100.0  # step 10 ms; offsets above are 0.3-0.5 steps
+        step_ns = int(round(1e9 / fs))
+        t0 = np.datetime64("2023-01-01T00:00:00", "ns")
+
+        def mk(start, n):
+            times = start + np.arange(n) * np.timedelta64(step_ns, "ns")
+            return Patch(
+                data=np.zeros((n, 1), np.float32),
+                coords={"time": times, "distance": np.array([0.0])},
+                dims=("time", "distance"),
+                attrs={"d_time": 1.0 / fs, "d_distance": 1.0},
+            )
+
+        a = mk(t0, 10)
+        gap = (k + 1) * step_ns + off_grid_ns
+        b = mk(
+            a.coords["time"][-1] + np.timedelta64(gap, "ns"), 10
+        )
+        out = merge_patches([a, b], max_fill=10.0)
+        assert len(out) == 2

@@ -63,4 +63,7 @@ BENCH_MODE=e2e BENCH_C=10000 BENCH_E2E_DTYPE=int16 BENCH_E2E_SEC=120 \
 echo "[$(stamp)] step 5: peak-HBM-per-window probe (memory model)"
 timeout 1800 python tools/hbm_probe.py 2>&1 | tee "$OUT/hbm_probe.log"
 
+echo "[$(stamp)] step 6: pallas-vs-xla crossover (retune _pallas_stage_ok)"
+timeout 1200 python tools/retune_stage_ok.py 2>&1 | tee "$OUT/retune.log"
+
 echo "[$(stamp)] campaign complete — logs in $OUT/"

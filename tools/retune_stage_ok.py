@@ -1,0 +1,93 @@
+"""Measure the pallas-vs-XLA crossover that _pallas_stage_ok encodes.
+
+The engine routes a cascade stage to the Pallas kernel only when it is
+big enough that kernel grid overheads don't dominate
+(``tpudas.ops.fir._pallas_stage_ok``: elements >= 2**24 and a full
+first grid step).  Those thresholds came from v1-era measurements; this
+tool re-measures both engines across a (n_out, n_ch) grid on the
+CURRENT kernel and prints per-point times plus the measured crossover,
+so retuning is reading a table instead of guesswork.
+
+Run on a live chip: ``python tools/retune_stage_ok.py``
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+
+from scan_harness import measure as _measure
+from tpudas.ops.fir import _block_taps, _polyphase_stage_xla, design_cascade
+from tpudas.ops.pallas_fir import fir_decimate_pallas, stage_input_rows
+
+# the flagship cascade's stage-0 filter (R=8) — the routing decision
+# that matters; smaller-R later stages scale the same way
+K_GRID = [2048, 4096, 8192, 16384, 32768]
+C_GRID = [128, 512, 2048]
+
+
+def main() -> None:
+    backend = jax.default_backend()
+    print(f"backend={backend}", flush=True)
+    if backend == "cpu":
+        print("cpu backend: interpret-mode times are meaningless here; "
+              "run on the TPU")
+        return
+    plan = design_cascade(1000.0, 1000, 0.45, 4)
+    R, h0 = plan.stages[0]
+    hb = _block_taps(np.asarray(h0), R)
+    B = int(hb.shape[0])
+    print(f"stage0: R={R} B={B}", flush=True)
+    print(f"{'n_out':>7} {'n_ch':>6} {'elems':>12} "
+          f"{'pallas ms':>10} {'xla ms':>9}  winner", flush=True)
+    crossover = []
+    for C in C_GRID:
+        for k in K_GRID:
+            T = stage_input_rows(B, R, k)
+            iters = 32
+            dt_p = None
+            try:
+                dt_p = _measure(
+                    lambda w: fir_decimate_pallas(w, hb, R, n_out=k),
+                    T, C, iters,
+                )
+            except Exception as exc:
+                print(f"{k:>7} {C:>6}  pallas failed: {str(exc)[:80]}",
+                      flush=True)
+            T_x = (k + B) * R
+            dt_x = _measure(
+                lambda w: _polyphase_stage_xla(w, hb, R, k), T_x, C, iters
+            )
+            elems = k * R * C
+            # an unrunnable pallas point counts as an XLA win: the
+            # threshold must route it away from the kernel
+            win = "pallas" if dt_p is not None and dt_p < dt_x else "xla"
+            crossover.append((elems, k, C, win))
+            p_ms = f"{dt_p * 1e3:>10.3f}" if dt_p is not None else "     -    "
+            print(
+                f"{k:>7} {C:>6} {elems:>12} {p_ms} "
+                f"{dt_x * 1e3:>9.3f}  {win}",
+                flush=True,
+            )
+    wins = sorted(e for e, _, _, w in crossover if w == "pallas")
+    loses = sorted(e for e, _, _, w in crossover if w == "xla")
+    if wins:
+        print(f"\nsmallest pallas win: {wins[0]} elements "
+              f"(2**{np.log2(wins[0]):.1f})")
+    if loses:
+        print(f"largest xla win:     {loses[-1]} elements "
+              f"(2**{np.log2(loses[-1]):.1f})")
+    print("current threshold:   2**24 — adjust _pallas_stage_ok "
+          "(tpudas/ops/fir.py) if the crossover moved")
+
+
+if __name__ == "__main__":
+    main()
