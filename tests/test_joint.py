@@ -1,0 +1,260 @@
+"""JointProc (BASELINE config 5): one ingest pass, two products.
+
+The LF product must be byte-identical to a plain LFProc run; the
+rolling product must tile seam-free across window boundaries and equal
+the pandas-semantics trailing mean computed on the merged raw stream.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from tpudas import spool
+from tpudas.proc.joint import JointProc
+from tpudas.proc.lfproc import LFProc
+from tpudas.testing import make_synthetic_spool
+
+FS = 100.0
+T1 = np.datetime64("2023-03-22T00:00:00")
+T2 = np.datetime64("2023-03-22T00:03:00")
+
+
+@pytest.fixture(scope="module")
+def raw_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("jointraw")
+    make_synthetic_spool(
+        d, n_files=6, file_duration=30.0, fs=FS, n_ch=6, noise=0.01
+    )
+    return str(d)
+
+
+def run_joint(src, out_lf, out_roll, mesh=None, t2=T2, **params):
+    cfg = dict(
+        output_sample_interval=1.0,
+        process_patch_size=60,
+        edge_buff_size=10,
+        rolling_window=2.0,
+        rolling_step=1.0,
+    )
+    cfg.update(params)
+    lfp = JointProc(spool(src).sort("time").update(), mesh=mesh)
+    lfp.update_processing_parameter(**cfg)
+    lfp.set_output_folder(str(out_lf), delete_existing=True)
+    lfp.set_rolling_output_folder(str(out_roll), delete_existing=True)
+    lfp.process_time_range(T1, t2)
+    return lfp
+
+
+def host_trailing_mean(data, taxis, w, s, emit_times):
+    """float64-free reference: trailing mean at the emitted times."""
+    out = []
+    for t in emit_times:
+        i = int(
+            round(
+                (t - taxis[0]) / np.timedelta64(1, "ns")
+                / ((taxis[1] - taxis[0]) / np.timedelta64(1, "ns"))
+            )
+        )
+        out.append(data[i - w + 1 : i + 1].mean(axis=0))
+    return np.stack(out)
+
+
+class TestJoint:
+    def test_lf_product_byte_identical_to_plain_lfproc(
+        self, raw_dir, tmp_path
+    ):
+        import filecmp
+
+        jl = run_joint(raw_dir, tmp_path / "lf", tmp_path / "roll")
+        assert jl.rolling_windows == sum(jl.engine_counts.values()) > 0
+        plain = LFProc(spool(raw_dir).sort("time").update())
+        plain.update_processing_parameter(
+            output_sample_interval=1.0,
+            process_patch_size=60,
+            edge_buff_size=10,
+        )
+        plain.set_output_folder(str(tmp_path / "lf2"), delete_existing=True)
+        plain.process_time_range(T1, T2)
+        a = sorted(os.listdir(tmp_path / "lf"))
+        b = sorted(os.listdir(tmp_path / "lf2"))
+        assert a == b
+        for n in a:
+            assert filecmp.cmp(
+                tmp_path / "lf" / n, tmp_path / "lf2" / n, shallow=False
+            )
+
+    def test_rolling_product_seam_free_and_correct(self, raw_dir, tmp_path):
+        run_joint(raw_dir, tmp_path / "lf", tmp_path / "roll")
+        merged = spool(str(tmp_path / "roll")).update().chunk(time=None)
+        assert len(merged) == 1, "rolling product has a seam"
+        p = merged[0]
+        times = p.coords["time"]
+        steps = np.diff(times) / np.timedelta64(1, "s")
+        assert np.allclose(steps, 1.0)  # rolling_step
+        # positions sit on the run's global grid (origin = bgtime)
+        off = (times - T1.astype("datetime64[ns]")) / np.timedelta64(1, "s")
+        assert np.allclose(off, np.round(off))
+        # values equal the trailing mean over the merged raw stream
+        raw = spool(raw_dir).update().chunk(time=None)[0]
+        rax = raw.coords["time"]
+        w = int(round(2.0 * FS))
+        ref = host_trailing_mean(
+            raw.host_data().astype(np.float64), rax, w, None, times
+        )
+        got = p.host_data()
+        assert np.abs(got - ref).max() < 1e-5 * np.abs(ref).max() + 1e-7
+
+    def test_rolling_product_is_complete_windows_only(
+        self, raw_dir, tmp_path
+    ):
+        """Every emitted rolling sample has a COMPLETE trailing window
+        (incomplete warm-up rows are never emitted — the baked-in
+        equivalent of the reference's dropna("time")), even at the
+        largest window the halo supports."""
+        run_joint(raw_dir, tmp_path / "lf", tmp_path / "roll",
+                  rolling_window=10.0)  # 1000 samples == the 10 s halo
+        merged = spool(str(tmp_path / "roll")).update().chunk(time=None)
+        assert len(merged) == 1
+        p = merged[0]
+        assert np.isfinite(p.host_data()).all()
+        # first emitted sample sits a full window past the data start
+        raw0 = spool(raw_dir).update()[0].attrs["time_min"]
+        lead = (
+            p.coords["time"][0].astype("datetime64[ns]")
+            - raw0.astype("datetime64[ns]")
+        ) / np.timedelta64(1, "s")
+        assert lead >= 10.0 - 1.0 / FS
+
+    def test_interior_window_halo_violation_raises(self, raw_dir, tmp_path):
+        lfp = JointProc(spool(raw_dir).sort("time").update())
+        lfp.update_processing_parameter(
+            output_sample_interval=1.0,
+            process_patch_size=60,
+            edge_buff_size=2,       # 2 s halo
+            rolling_window=5.0,     # needs 5 s of trailing history
+        )
+        lfp.set_output_folder(str(tmp_path / "lf"), delete_existing=True)
+        lfp.set_rolling_output_folder(
+            str(tmp_path / "roll"), delete_existing=True
+        )
+        with pytest.raises(ValueError, match="edge_buff_size"):
+            lfp.process_time_range(T1, T2)
+
+    def test_int16_payload_matches_f32(self, tmp_path):
+        outs = {}
+        for label, wk in (
+            ("f32", None),
+            ("i16", {"dtype": "int16", "scale": 1e-3}),
+        ):
+            d = tmp_path / f"raw_{label}"
+            make_synthetic_spool(
+                d, n_files=4, file_duration=30.0, fs=FS, n_ch=4,
+                noise=0.01, format="tdas", write_kwargs=wk,
+            )
+            run_joint(
+                str(d), tmp_path / f"lf_{label}", tmp_path / f"r_{label}",
+                t2=np.datetime64("2023-03-22T00:02:00"),
+            )
+            outs[label] = (
+                spool(str(tmp_path / f"r_{label}"))
+                .update()
+                .chunk(time=None)[0]
+                .host_data()
+            )
+        scale = np.abs(outs["f32"]).max()
+        # int16 quantization error bound: ~scale/2 per sample, averaged
+        assert np.abs(outs["f32"] - outs["i16"]).max() < 2e-3 * scale + 1e-3
+
+    def test_mesh_run_matches_single_device(self, raw_dir, tmp_path):
+        from tpudas.parallel.mesh import make_mesh
+
+        run_joint(raw_dir, tmp_path / "lf1", tmp_path / "r1")
+        run_joint(
+            raw_dir, tmp_path / "lf2", tmp_path / "r2",
+            mesh=make_mesh(8),
+        )
+        a = (
+            spool(str(tmp_path / "r1")).update().chunk(time=None)[0]
+        ).host_data()
+        b = (
+            spool(str(tmp_path / "r2")).update().chunk(time=None)[0]
+        ).host_data()
+        # the sharded compilation may pick a different (but equally
+        # valid) reduce_window summation tree than the single-device
+        # one — near-equality, unlike the LF product's byte-equality
+        assert np.abs(a - b).max() < 1e-6 * np.abs(a).max()
+
+
+@pytest.mark.slow
+def test_config5_width_50k_channels(tmp_path):
+    """BASELINE config 5 WIDTH: the joint pipeline at 50,000 channels
+    through the full product path (tdas int16 spool -> native assembly
+    -> both device products -> HDF5), channels shardable over the
+    8-device mesh. Reduced rate/duration on CPU; rate on silicon is
+    the campaign's business."""
+    from tpudas.parallel.mesh import make_mesh
+
+    fs, n_ch = 25.0, 50_000
+    d = tmp_path / "raw"
+    make_synthetic_spool(
+        d, n_files=2, file_duration=30.0, fs=fs, n_ch=n_ch, noise=0.01,
+        format="tdas", write_kwargs={"dtype": "int16", "scale": 1e-3},
+    )
+    lfp = JointProc(
+        spool(str(d)).sort("time").update(), mesh=make_mesh(8)
+    )
+    lfp.update_processing_parameter(
+        output_sample_interval=1.0,
+        process_patch_size=30,
+        edge_buff_size=5,
+        rolling_window=2.0,
+        rolling_step=1.0,
+    )
+    lfp.set_output_folder(str(tmp_path / "lf"), delete_existing=True)
+    lfp.set_rolling_output_folder(
+        str(tmp_path / "roll"), delete_existing=True
+    )
+    lfp.process_time_range(
+        np.datetime64("2023-03-22T00:00:00"),
+        np.datetime64("2023-03-22T00:01:00"),
+    )
+    assert lfp.native_windows == sum(lfp.engine_counts.values()) > 0
+    assert lfp.rolling_windows == lfp.native_windows
+    for folder in ("lf", "roll"):
+        merged = spool(str(tmp_path / folder)).update().chunk(time=None)
+        assert len(merged) == 1
+        p = merged[0]
+        assert p.host_data().shape[p.dims.index("distance")] == n_ch
+        assert np.isfinite(p.host_data()).all()
+
+
+def test_window_dp_carries_rolling_product(tmp_path):
+    """The window-DP batched path emits the rolling product too (the
+    per-window hook is bypassed; the DP flush loop calls it), with
+    output equal to the serial joint run."""
+    from tpudas.parallel.mesh import make_mesh
+    from tpudas.utils.logging import set_log_handler
+
+    d = tmp_path / "raw"
+    make_synthetic_spool(
+        d, n_files=6, file_duration=30.0, fs=FS, n_ch=6, noise=0.01
+    )
+    events = []
+    set_log_handler(events.append)
+    try:
+        run_joint(str(d), tmp_path / "lf1", tmp_path / "r1")
+        run_joint(
+            str(d), tmp_path / "lf2", tmp_path / "r2",
+            mesh=make_mesh(8, time_shards=2), window_dp=True,
+        )
+    finally:
+        set_log_handler(None)
+    assert [e for e in events if e["event"] == "window_dp_batch"], \
+        "no DP batch actually ran"
+    a = spool(str(tmp_path / "r1")).update().chunk(time=None)
+    b = spool(str(tmp_path / "r2")).update().chunk(time=None)
+    assert len(a) == 1 and len(b) == 1
+    assert np.abs(
+        a[0].host_data() - b[0].host_data()
+    ).max() < 1e-6 * np.abs(a[0].host_data()).max()

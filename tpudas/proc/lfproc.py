@@ -247,6 +247,8 @@ class LFProc:
         # rest of the run executes per-window instead of paying a
         # doomed stack transfer on every batch
         self._window_dp_ok = True
+        self._run_origin_ns = None  # set per process_time_range run
+        self._first_window_of_run = True
         self._dp_proven = set()  # DP keys whose batched kernel passed
         self._dp_bad = set()  # (key, impl) pairs whose batched pallas
         # run failed the first-batch cross-check (kept per-window while
@@ -343,14 +345,21 @@ class LFProc:
         return FrozenDict(self._para)
 
     # output folder / resume ------------------------------------------
-    def set_output_folder(self, folder, delete_existing=False):
-        self._output_folder = folder
+    @staticmethod
+    def _setup_folder(folder, delete_existing):
+        """Shared create/wipe behavior for every output folder (the LF
+        product here, joint products in subclasses) — messages match
+        the reference (lf_das.py:188-195)."""
         if delete_existing and os.path.isdir(folder):
             shutil.rmtree(folder)
             print(f"original {folder} deleted")
         if not os.path.isdir(folder):
             os.makedirs(folder)
             print(f"{folder} created")
+
+    def set_output_folder(self, folder, delete_existing=False):
+        self._output_folder = folder
+        self._setup_folder(folder, delete_existing)
 
     def get_last_processed_time(self):
         """Resume primitive: progress state lives entirely in the output
@@ -449,6 +458,13 @@ class LFProc:
 
         bgtime = to_datetime64(bgtime)
         edtime = to_datetime64(edtime)
+        # run anchor for joint products whose output grid is phased in
+        # input samples (see tpudas.proc.joint); also marks the run's
+        # first window (whose rolling warm-up may legitimately clamp)
+        self._run_origin_ns = int(
+            bgtime.astype("datetime64[ns]").astype(np.int64)
+        )
+        self._first_window_of_run = True
         time_grid = build_time_grid(bgtime, edtime, dt)
         if on_gap == "split":
             # a globally invalid patch/buff relation must fail loudly
@@ -488,10 +504,17 @@ class LFProc:
             trace_cm = device_trace(trace_dir)
         else:
             trace_cm = contextlib.nullcontext()
-        with trace_cm:
-            total_windows = self._process_segments(
-                time_grid, segments, on_gap
-            )
+        try:
+            with trace_cm:
+                total_windows = self._process_segments(
+                    time_grid, segments, on_gap
+                )
+        finally:
+            # the run anchor must not leak into later direct
+            # _process_window use (whose documented fallback is a
+            # window-local origin)
+            self._run_origin_ns = None
+            self._first_window_of_run = True
         log_event(
             "process_time_range_done",
             windows=total_windows,
@@ -755,7 +778,15 @@ class LFProc:
                 "window_dp_batch", windows=len(pending), engine=ran,
                 rows=rows, emitted=int(pending[0][2]["n_out"]),
             )
-            for i, (patch, emit_times, _) in enumerate(pending):
+            for i, (patch, emit_times, info) in enumerate(pending):
+                # joint extras run here too (the per-window hook is
+                # bypassed by batched execution); before the LF write,
+                # same crash-ordering contract as _process_window
+                self._emit_window_extras(
+                    patch, info["host"], info["qs"],
+                    patch.coords["time"], emit_times, dt,
+                    patch.get_sample_step("time"),
+                )
                 self._emit_window_output(
                     patch, emit_times, dt, out[i], ran,
                     rows=rows, t_dev=t_dev / len(pending),
@@ -1190,10 +1221,29 @@ class LFProc:
         out = np.asarray(out)  # forces the device chain (host sync)
         t_dev = time.perf_counter() - t_dev0
         self.timings["device_s"] += t_dev
+        # joint products (tpudas.proc.joint.JointProc): additional
+        # outputs computed from the SAME loaded window/payload — one
+        # ingest pass, several products.  No-op in the base engine.
+        # Emitted BEFORE the LF file: resume state is the LF output
+        # folder, so a crash between the two writes must leave the
+        # window unmarked-as-done (the rolling file is then simply
+        # rewritten on resume — filenames are deterministic) rather
+        # than leave a permanent hole in the rolling stream.
+        self._emit_window_extras(
+            window_patch, staged if staged is not None else host, qs,
+            taxis, target_times, dt, d_sec,
+        )
         self._emit_window_output(
             window_patch, target_times, dt, out, ran,
             rows=int(host.shape[0]), t_dev=t_dev,
         )
+
+    def _emit_window_extras(self, window_patch, payload, qs, taxis,
+                            target_times, dt, d_sec):
+        """Hook for subclasses emitting extra per-window products.
+        ``payload`` is the time-major window — the already-staged
+        DEVICE array when the prefetch thread transferred it (no second
+        H2D), the host array otherwise."""
 
     def _emit_window_output(self, window_patch, target_times, dt, out, ran,
                             rows, t_dev=0.0):
