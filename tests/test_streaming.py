@@ -357,3 +357,86 @@ class TestTerminationAndRecovery:
         assert rounds == 2
         produced = [f for f in os.listdir(out) if f.endswith(".h5")]
         assert produced  # the second round recovered and emitted output
+
+
+class TestJointRealtime:
+    def test_joint_streaming_rolls_and_resumes(self, tmp_path):
+        """The realtime loop with a rolling_output_folder emits BOTH
+        products each round (config 5, streaming form); across resumed
+        rounds the rolling product stays seam-free and matches a batch
+        JointProc run over the full stream interior."""
+        from tpudas.proc.joint import JointProc
+
+        src = str(tmp_path / "raw")
+        out = str(tmp_path / "results")
+        roll = str(tmp_path / "rolling")
+        make_synthetic_spool(
+            src, n_files=3, file_duration=FILE_SEC, fs=FS, n_ch=NCH,
+            noise=0.01,
+        )
+        state = {"fed": 0}
+
+        def fake_sleep(_):
+            if state["fed"] < 1:
+                _append_files(src, 3, 2)
+                state["fed"] += 1
+
+        rounds = run_lowpass_realtime(
+            source=src,
+            output_folder=out,
+            start_time="2023-03-22T00:00:00",
+            output_sample_interval=1.0,
+            edge_buffer=8.0,
+            process_patch_size=40,
+            poll_interval=0.0,
+            file_duration=0.0,
+            sleep_fn=fake_sleep,
+            rolling_output_folder=roll,
+            rolling_window=3.0,
+            rolling_step=1.0,
+        )
+        assert rounds == 2
+        merged = spool(roll).update().chunk(time=None)
+        assert len(merged) == 1, "streamed rolling product has a seam"
+        got = merged[0]
+        assert np.isfinite(got.host_data()).all()
+        steps = np.diff(got.coords["time"].astype(np.int64))
+        assert np.all(steps == 1_000_000_000)
+        # batch joint run over the same (final) stream for comparison
+        jp = JointProc(spool(src).sort("time").update())
+        jp.update_processing_parameter(
+            output_sample_interval=1.0,
+            process_patch_size=40,
+            edge_buff_size=8,
+            rolling_window=3.0,
+            rolling_step=1.0,
+        )
+        jp.set_output_folder(str(tmp_path / "blf"), delete_existing=True)
+        jp.set_rolling_output_folder(
+            str(tmp_path / "broll"), delete_existing=True
+        )
+        jp.process_time_range(
+            np.datetime64("2023-03-22T00:00:00"),
+            np.datetime64(
+                spool(src).update().get_contents()["time_max"].max()
+            ),
+        )
+        ref = spool(str(tmp_path / "broll")).update().chunk(time=None)[0]
+        ta, tb = got.coords["time"], ref.coords["time"]
+        lo, hi = max(ta[0], tb[0]), min(ta[-1], tb[-1])
+        a = got.select(time=(lo, hi)).host_data()
+        b = ref.select(time=(lo, hi)).host_data()
+        assert a.shape == b.shape
+        assert np.abs(a - b).max() < 1e-6 * np.abs(b).max() + 1e-7
+
+    def test_rolling_params_without_folder_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="rolling_output_folder"):
+            run_lowpass_realtime(
+                source=str(tmp_path),
+                output_folder=str(tmp_path / "out"),
+                start_time="2023-03-22T00:00:00",
+                output_sample_interval=1.0,
+                edge_buffer=8.0,
+                process_patch_size=40,
+                rolling_window=3.0,
+            )

@@ -150,6 +150,22 @@ class JointProc(LFProc):
                 f"sample ({self._para['rolling_window']} / "
                 f"{self._para['rolling_step']} s at {1 / d_sec:.6g} Hz)"
             )
+        # the halo relation, re-checked against the ACTUAL sample rate
+        # of the loaded window: when the spool index carries no
+        # time_step the upfront check in process_time_range cannot run,
+        # and a fresh-processor-per-round driver (streaming) would
+        # otherwise hit the stream-head clamp on every round's first
+        # window — silently dropping rolling samples at each resume
+        # seam instead of raising
+        halo_in = int(round(
+            float(self._para["edge_buff_size"]) * float(dt) / d_sec
+        ))
+        if w - 1 > halo_in:
+            raise ValueError(
+                f"rolling_window ({w} input samples) exceeds the edge "
+                f"halo ({halo_in}); increase edge_buff_size so the "
+                "rolling product stays seam-free"
+            )
         step_ns = int(round(d_sec * 1e9))
         t0_ns = int(taxis[0].astype("datetime64[ns]").astype(np.int64))
         origin = self._run_origin_ns

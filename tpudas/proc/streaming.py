@@ -106,6 +106,9 @@ def run_lowpass_realtime(
     window_dp=None,
     counters=None,
     mesh=None,
+    rolling_output_folder=None,
+    rolling_window=None,
+    rolling_step=None,
 ):
     """Poll ``source`` and keep the low-pass output current.
 
@@ -118,10 +121,27 @@ def run_lowpass_realtime(
     accumulate throughput; each processing round also emits a
     ``realtime_round`` event with its own real-time factor.
 
+    ``rolling_output_folder`` (with ``rolling_window`` /
+    ``rolling_step``, seconds) switches the round processor to
+    :class:`tpudas.proc.joint.JointProc`: every round emits BOTH the
+    low-pass product and the seam-free trailing rolling mean from one
+    ingest pass (BASELINE config 5, streaming form). For cross-round
+    rolling-grid alignment use a ``rolling_step`` that divides
+    ``output_sample_interval`` (each round's grid is anchored at its
+    own resume point, which sits on the output grid).
+
     Returns the number of rounds that processed data. Terminates when a
     poll sees no new files (reference semantics) or after
     ``max_rounds``.
     """
+    if rolling_output_folder is None and (
+        rolling_window is not None or rolling_step is not None
+    ):
+        raise ValueError(
+            "rolling_window/rolling_step require rolling_output_folder "
+            "(the joint-pipeline switch) — without it no rolling "
+            "product would be written"
+        )
     d_t = float(output_sample_interval)
     buff_out = int(np.ceil(edge_buffer / d_t))
     interval = clamp_poll_interval(poll_interval, file_duration, edge_buffer)
@@ -154,14 +174,31 @@ def run_lowpass_realtime(
             print("No new data was detected. Real-time processing ended successfully.")
             break
         if n_now > 0:
-            lfp = LFProc(sub, mesh=mesh)
+            joint_extra = {}
+            if rolling_output_folder is not None:
+                from tpudas.proc.joint import JointProc
+
+                lfp = JointProc(sub, mesh=mesh)
+                joint_extra = {
+                    k: v
+                    for k, v in (("rolling_window", rolling_window),
+                                 ("rolling_step", rolling_step))
+                    if v is not None
+                }
+            else:
+                lfp = LFProc(sub, mesh=mesh)
             lfp.update_processing_parameter(
                 output_sample_interval=d_t,
                 process_patch_size=int(process_patch_size),
                 edge_buff_size=buff_out,
                 **extra,
+                **joint_extra,
             )
             lfp.set_output_folder(output_folder, delete_existing=False)
+            if rolling_output_folder is not None:
+                lfp.set_rolling_output_folder(
+                    rolling_output_folder, delete_existing=False
+                )
             rounds += 1
             print("run number: ", rounds)
             if not processed_once:
