@@ -93,6 +93,8 @@ BENCH_PALLAS=0/1, BENCH_INCLUDE_H2D=0/1, BENCH_COMPARE=0/1,
 BENCH_QUANT=0/1 (int16-payload kernel measurement),
 BENCH_PROFILE=0/1 (per-stage cascade breakdown),
 BENCH_MODE=kernel/e2e, BENCH_E2E_SEC, BENCH_E2E_FS, BENCH_E2E_TIMEOUT,
+BENCH_E2E_JOINT=0/1 (joint low-pass + rolling products, config 5;
+geometry via BENCH_E2E_ROLL_W / BENCH_E2E_ROLL_S seconds),
 BENCH_BUDGET (total parent wall budget, s), BENCH_PROBE_TIMEOUT,
 BENCH_CHILD_TIMEOUT.
 """
@@ -540,9 +542,11 @@ def _e2e_child(backend: str) -> None:
     sec = int(n_files * file_sec)
     start = "2023-03-22T00:00:00"
 
+    joint = os.environ.get("BENCH_E2E_JOINT", "0") == "1"
     with tempfile.TemporaryDirectory() as td:
         src = os.path.join(td, "src")
         out = os.path.join(td, "out")
+        out_roll = os.path.join(td, "out_roll")
         print(
             f"[bench] e2e: synthesizing {sec}s x {C}ch @ {fs:.0f}Hz tdas "
             "spool",
@@ -554,7 +558,20 @@ def _e2e_child(backend: str) -> None:
             fs=fs, n_ch=C, noise=0.01, lf_freq=0.05, hf_freq=40.0,
             format="tdas", write_kwargs=write_kwargs,
         )
-        lfp = LFProc(make_spool(src).sort("time").update())
+        roll_w = float(os.environ.get("BENCH_E2E_ROLL_W", 5.0))
+        roll_s = float(os.environ.get("BENCH_E2E_ROLL_S", 1.0))
+        if joint:
+            # BENCH_E2E_JOINT=1: BOTH products (low-pass + rolling
+            # mean) from the one ingest pass — BASELINE config 5's
+            # workload shape
+            from tpudas.proc.joint import JointProc
+
+            lfp = JointProc(make_spool(src).sort("time").update())
+            lfp.update_processing_parameter(
+                rolling_window=roll_w, rolling_step=roll_s,
+            )
+        else:
+            lfp = LFProc(make_spool(src).sort("time").update())
         lfp.update_processing_parameter(
             output_sample_interval=1.0,
             process_patch_size=60,
@@ -562,12 +579,15 @@ def _e2e_child(backend: str) -> None:
             engine=engine,
         )
         lfp.set_output_folder(out, delete_existing=True)
+        if joint:
+            lfp.set_rolling_output_folder(out_roll, delete_existing=True)
         t0 = _np.datetime64(start)
         t1 = t0 + _np.timedelta64(sec, "s")
         w0 = time.perf_counter()
         lfp.process_time_range(t0, t1)
         elapsed = time.perf_counter() - w0
         n_out = len(os.listdir(out))
+        n_roll = len(os.listdir(out_roll)) if joint else None
 
     value = sec * fs * C / elapsed
     samples = sec * fs * C
@@ -599,6 +619,9 @@ def _e2e_child(backend: str) -> None:
                 "native_windows": lfp.native_windows,
                 "engine_counts": lfp.engine_counts,
                 "output_files": n_out,
+                **({"joint": True, "rolling_files": n_roll,
+                    "rolling_window_s": roll_w, "rolling_step_s": roll_s}
+                   if joint else {}),
                 "timings_s": timings,
                 "phase_rates": phase_rates,
             }

@@ -56,9 +56,15 @@ fi
 
 echo "[$(stamp)] step 4: e2e at north-star width (10k ch, int16 ingest)"
 BENCH_MODE=e2e BENCH_C=10000 BENCH_E2E_DTYPE=int16 BENCH_E2E_SEC=120 \
-  BENCH_BUDGET=1700 BENCH_CHILD_TIMEOUT=1500 BENCH_E2E_TIMEOUT=1500 \
+  BENCH_BUDGET=1700 BENCH_CHILD_TIMEOUT=1500 \
   timeout 1800 python bench.py 2>"$OUT/e2e10k_stderr.log" \
   | tee "$OUT/e2e10k.log"
+
+echo "[$(stamp)] step 4b: joint e2e (config-5 workload shape, both products)"
+BENCH_MODE=e2e BENCH_E2E_JOINT=1 BENCH_C=2048 BENCH_E2E_DTYPE=int16 \
+  BENCH_BUDGET=1100 BENCH_CHILD_TIMEOUT=900 \
+  timeout 1200 python bench.py 2>"$OUT/e2e_joint_stderr.log" \
+  | tee "$OUT/e2e_joint.log"
 
 echo "[$(stamp)] step 5: peak-HBM-per-window probe (memory model)"
 timeout 1800 python tools/hbm_probe.py 2>&1 | tee "$OUT/hbm_probe.log"
