@@ -401,3 +401,112 @@ class TestGapFillProperties:
         )
         out = merge_patches([a, b], max_fill=10.0)
         assert len(out) == 2
+
+
+class TestJointCrashResumeProperty:
+    """Crash-ordering contract of the joint pipeline: the rolling file
+    of a window is written BEFORE its LF file, and resume state is the
+    LF folder — so a kill at ANY window boundary (including between
+    the two writes of one window) leaves a stream that resume heals
+    into outputs equal to an uninterrupted run, for BOTH products."""
+
+    FS = 100.0
+    DT = 1.0
+    BUFF = 5
+    PATCH = 40
+    T1, T2 = "2023-03-22T00:00:00", "2023-03-22T00:03:00"
+
+    def _mk(self, src, out_lf, out_roll, delete=True):
+        from tpudas import spool
+        from tpudas.proc.joint import JointProc
+
+        jp = JointProc(spool(src).sort("time").update())
+        jp.update_processing_parameter(
+            output_sample_interval=self.DT,
+            process_patch_size=self.PATCH,
+            edge_buff_size=self.BUFF,
+            rolling_window=3.0,
+            rolling_step=1.0,
+        )
+        jp.set_output_folder(str(out_lf), delete_existing=delete)
+        jp.set_rolling_output_folder(str(out_roll), delete_existing=delete)
+        return jp
+
+    @pytest.fixture(scope="class")
+    def joint_spool(self, tmp_path_factory):
+        from tpudas.testing import make_synthetic_spool
+
+        d = tmp_path_factory.mktemp("jcrashraw")
+        make_synthetic_spool(
+            d, n_files=6, file_duration=30.0, fs=self.FS, n_ch=4,
+            noise=0.01,
+        )
+        return str(d)
+
+    @pytest.fixture(scope="class")
+    def joint_full(self, joint_spool, tmp_path_factory):
+        from tpudas import spool
+
+        base = tmp_path_factory.mktemp("jfull")
+        jp = self._mk(joint_spool, base / "lf", base / "roll")
+        jp.process_time_range(np.datetime64(self.T1), np.datetime64(self.T2))
+        return (
+            spool(str(base / "lf")).update().chunk(time=None)[0],
+            spool(str(base / "roll")).update().chunk(time=None)[0],
+        )
+
+    @settings(max_examples=6, deadline=None)
+    @given(k=st.integers(1, 6), between=st.booleans())
+    def test_kill_any_window_both_products_heal(
+        self, k, between, joint_spool, joint_full, tmp_path_factory
+    ):
+        from tpudas import spool
+        from tpudas.proc.lfproc import LFProc, schedule_windows
+
+        n_wins = len(schedule_windows(181, self.PATCH, self.BUFF))
+        k = min(k, n_wins - 1)
+        base = tmp_path_factory.mktemp(f"jcrash{k}{int(between)}")
+        jp = self._mk(joint_spool, base / "lf", base / "roll")
+
+        real = LFProc._emit_window_output
+        calls = {"n": 0}
+
+        def dying(self_, *a, **kw):
+            # crash either before this window's LF write (the rolling
+            # file for it is already on disk — `between`) or after it
+            if calls["n"] >= k and between:
+                raise KeyboardInterrupt("between the two writes")
+            r = real(self_, *a, **kw)
+            calls["n"] += 1
+            if calls["n"] >= k and not between:
+                raise KeyboardInterrupt("after the window")
+            return r
+
+        LFProc._emit_window_output = dying
+        try:
+            with pytest.raises(KeyboardInterrupt):
+                jp.process_time_range(
+                    np.datetime64(self.T1), np.datetime64(self.T2)
+                )
+        finally:
+            LFProc._emit_window_output = real
+
+        # resume exactly like the real-time loop
+        jp2 = self._mk(joint_spool, base / "lf", base / "roll",
+                       delete=False)
+        t_last = jp2.get_last_processed_time()
+        rewind = int((self.BUFF - 1) * self.DT)
+        jp2.process_time_range(
+            t_last - np.timedelta64(rewind, "s"), np.datetime64(self.T2)
+        )
+        full_lf, full_roll = joint_full
+        for folder, ref in (("lf", full_lf), ("roll", full_roll)):
+            merged = spool(str(base / folder)).update().chunk(time=None)
+            assert len(merged) == 1, f"{folder}: seam or hole after resume"
+            got = merged[0]
+            ta, tb = got.coords["time"], ref.coords["time"]
+            lo, hi = max(ta[0], tb[0]), min(ta[-1], tb[-1])
+            a = got.select(time=(lo, hi)).host_data()
+            b = ref.select(time=(lo, hi)).host_data()
+            scale = max(float(np.abs(b).max()), 1e-30)
+            assert np.abs(a - b).max() < 5e-3 * scale, folder
