@@ -69,6 +69,45 @@ def main():
     print(f"stage0 int16 rel err:        {err_q:.2e} "
           f"({'OK' if err_q < 1e-4 else 'FAIL'})", flush=True)
 
+    # the v1 (VPU) implementation — the middle fallback tier — must
+    # also hold numerics on this backend (both payloads).  Failures
+    # here must not abort the script: the v2 cascade check and the
+    # rate sections below are the round's primary capture.
+    prev = os.environ.get("TPUDAS_PALLAS_IMPL")
+    os.environ["TPUDAS_PALLAS_IMPL"] = "v1"
+    try:
+        for label, inp, reference, scale in (
+            ("f32", x, ref, None),
+            ("int16", q, ref_q, s),
+        ):
+            try:
+                got1 = np.asarray(
+                    fir_decimate_pallas(
+                        jnp.asarray(inp), hb, R, n_out=n_out,
+                        interpret=interp,
+                    )
+                )
+                if scale is not None:
+                    got1 = scale * got1
+                err1 = (
+                    np.abs(got1 - reference).max()
+                    / np.abs(reference).max()
+                )
+                print(
+                    f"stage0 v1 {label} rel err:"
+                    f"{'':{9 - len(label)}s}{err1:.2e} "
+                    f"({'OK' if err1 < 1e-4 else 'FAIL'})",
+                    flush=True,
+                )
+            except Exception as exc:
+                print(f"stage0 v1 {label}: FAILED "
+                      f"({str(exc)[:120]})", flush=True)
+    finally:
+        if prev is None:
+            os.environ.pop("TPUDAS_PALLAS_IMPL", None)
+        else:
+            os.environ["TPUDAS_PALLAS_IMPL"] = prev
+
     # 2. full cascade, engine auto (exercises chain layout + fallback);
     # interpret mode is orders slower, so CPU shrinks the shapes
     Tw, Cw, Kw = (200000, 512, 150) if not interp else (40000, 64, 16)

@@ -1,0 +1,32 @@
+#!/bin/bash
+# Chain: poll the tunneled backend until alive, then IMMEDIATELY run
+# the full chip campaign — the tunnel has historically come back at
+# unpredictable times and died again within the session, so the
+# capture must start the moment recovery is seen, not when a human
+# notices.  Logs: chip_r05/ + campaign stdout to chip_r05/campaign.log
+cd "$(dirname "$0")/.."
+for i in $(seq 1 80); do
+  if timeout 120 python -c "
+import jax
+assert jax.default_backend() != 'cpu'
+import jax.numpy as jnp
+assert float((jnp.ones((128,128)) @ jnp.ones((128,128))).sum()) == 128.0*128*128
+print('TPU ALIVE:', jax.devices())
+" 2>/dev/null; then
+    echo "tpu up on probe $i at $(date -u +%H:%M:%S) — starting campaign"
+    mkdir -p chip_r05
+    bash tools/chip_campaign.sh 2>&1 | tee chip_r05/campaign.log
+    rc=${PIPESTATUS[0]}
+    if [ "$rc" -eq 0 ]; then
+      exit 0
+    fi
+    # tunnel flapped between the probe and campaign step 0: keep
+    # watching for the next recovery window instead of reporting
+    # success on a failed campaign
+    echo "campaign rc=$rc — resuming watch"
+  fi
+  echo "probe $i: dead at $(date -u +%H:%M:%S)"
+  sleep 540
+done
+echo "gave up after $i probes"
+exit 1
