@@ -67,8 +67,16 @@ def main():
     report("read-ceiling (sum)", T0, dt)
 
     # product kernel: (kb, cb) sweep; kb=512 is the product default
-    # (P=4 parallel 128-frame sub-blocks per grid step)
-    for kb, cb in [(512, 128), (512, 256), (1024, 128), (256, 128)]:
+    # (P=4 parallel 128-frame sub-blocks per grid step).  Geometry
+    # lists are env-overridable so a live session can widen or narrow
+    # the sweep without code edits: STAGE0_KBS / STAGE0_CBS are
+    # comma-separated (all kb x cb combinations are measured).
+    kbs = [int(v) for v in os.environ.get(
+        "STAGE0_KBS", "256,512,1024").split(",")]
+    cbs = [int(v) for v in os.environ.get(
+        "STAGE0_CBS", "128,256").split(",")]
+    geoms = [(kb, cb) for kb in kbs for cb in cbs]
+    for kb, cb in geoms:
         n_out = -(-16000 // kb) * kb
         T = stage_input_rows(B, R, n_out, kb)
         try:
@@ -82,18 +90,25 @@ def main():
         except Exception as exc:
             print(f"pallas kb={kb} cb={cb}: {str(exc)[:120]}", flush=True)
 
-    # raw int16 payload (the quantized tdas ingest): half the read
-    n_out = 16384
-    T = stage_input_rows(B, R, n_out, 512)
-    try:
-        dt = measure(
-            lambda x: fir_decimate_pallas(x, hb, R, n_out=n_out),
-            T,
-            dtype="int16",
-        )
-        report("pallas int16 kb=512 cb=128", T, dt, 2.0, 2 * 4 / 8)
-    except Exception as exc:
-        print(f"pallas int16: {str(exc)[:120]}", flush=True)
+    # raw int16 payload (the quantized tdas ingest): half the read —
+    # swept over the same geometries (the winning f32 geometry is not
+    # necessarily the winning int16 one: the DMA is half-width but the
+    # in-kernel cast adds VPU work)
+    for kb, cb in geoms:
+        n_out = -(-16000 // kb) * kb
+        T = stage_input_rows(B, R, n_out, kb)
+        try:
+            dt = measure(
+                lambda x, kb=kb, cb=cb, n_out=n_out: fir_decimate_pallas(
+                    x, hb, R, n_out=n_out, kb=kb, cb=cb
+                ),
+                T,
+                dtype="int16",
+            )
+            report(f"pallas i16 kb={kb} cb={cb}", T, dt, 2.0, 2 * 4 / 8)
+        except Exception as exc:
+            print(f"pallas i16 kb={kb} cb={cb}: {str(exc)[:120]}",
+                  flush=True)
 
     # XLA polyphase reference
     n_out = 16128
