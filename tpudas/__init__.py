@@ -19,7 +19,7 @@ from tpudas.core.mapping import FrozenDict
 from tpudas.io.spool import spool, BaseSpool, MemorySpool, DirectorySpool
 from tpudas.core import units
 
-__version__ = "0.1.0"
+__version__ = "0.5.0"
 
 __all__ = [
     "Patch",
