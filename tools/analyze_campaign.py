@@ -1,0 +1,161 @@
+"""Digest a chip-campaign run (chip_r05/) into decisions.
+
+Parses the campaign logs and prints: the Mosaic verdict on the v2
+kernel, the numerics table, the winning stage-0 geometry per payload
+(and the env defaults to bake), the bench headline vs the 29.06 G
+record and the roofline, the e2e bottleneck breakdown, and the
+pallas/xla crossover recommendation for ``_pallas_stage_ok``.
+
+Run after ``tools/chip_campaign.sh``: ``python tools/analyze_campaign.py``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+OUT = sys.argv[1] if len(sys.argv) > 1 else "chip_r05"
+
+
+def _read(name: str) -> str:
+    try:
+        with open(os.path.join(OUT, name)) as fh:
+            return fh.read()
+    except OSError:
+        return ""
+
+
+def main() -> None:
+    if not os.path.isdir(OUT):
+        print(f"no {OUT}/ directory — run tools/chip_campaign.sh first")
+        return
+
+    print(f"=== campaign digest ({OUT}) ===\n")
+
+    # 1. chip_check: numerics verdicts
+    cc = _read("chip_check.log")
+    if cc:
+        fails = [ln for ln in cc.splitlines() if "FAIL" in ln]
+        oks = [ln for ln in cc.splitlines() if "(OK)" in ln]
+        print("chip_check:")
+        for ln in oks + fails:
+            print("  " + ln.strip())
+        if "Mosaic is NOT exercised" in cc or "backend=cpu" in cc:
+            # interpret-mode numbers say nothing about the compiled
+            # kernel — never report a Mosaic verdict off them
+            print("  => v2 Mosaic verdict: UNTESTED (cpu/interpret "
+                  "run — the log itself disclaims it)\n")
+        else:
+            # any FAIL from the v2 checks disqualifies (int16 and the
+            # cascade exercise the same kernel); only the v1
+            # fallback-tier lines are excluded from the verdict
+            v2_fails = [ln for ln in fails if "stage0 v1" not in ln]
+            v2_ok = bool(oks) and not v2_fails
+            print(f"  => v2 Mosaic verdict: "
+                  f"{'ACCEPTED' if v2_ok else 'REJECTED/FAILED'}\n")
+    else:
+        print("chip_check: no log\n")
+
+    # 2. stage-0 sweep: best geometry per payload
+    ps = _read("perf_stage0.log")
+    if ps:
+        best: dict = {}
+        for m in re.finditer(
+            r"pallas (f32|i16) kb=(\d+) cb=(\d+)\s+[\d.]+ ms/win\s+"
+            r"([\d.]+) G ch-samp/s\s+([\d.]+) GB/s",
+            ps,
+        ):
+            pay, kb, cb, gsps, gbps = m.groups()
+            rec = (float(gsps), int(kb), int(cb), float(gbps))
+            if pay not in best or rec > best[pay]:
+                best[pay] = rec
+        ceiling = re.search(
+            r"read-ceiling \(sum\)\s+[\d.]+ ms/win\s+[\d.]+ G ch-samp/s"
+            r"\s+([\d.]+) GB/s", ps,
+        )
+        print("stage-0 sweep:")
+        if ceiling:
+            print(f"  harness read ceiling: {ceiling.group(1)} GB/s")
+        for pay, (gsps, kb, cb, gbps) in sorted(best.items()):
+            print(f"  best {pay}: kb={kb} cb={cb} -> {gsps:.2f} G "
+                  f"ch-samp/s ({gbps:.0f} GB/s)")
+        if "f32" in best:
+            _, kb, cb, gbps = best["f32"]
+            print(f"  => bake: TPUDAS_PALLAS_P={kb // 128} "
+                  f"TPUDAS_PALLAS_CB={cb}")
+            print(f"  => P-stream hypothesis "
+                  f"{'HOLDS' if gbps > 230 else 'does NOT hold'} "
+                  f"(target >230 GB/s; single-stream wall ~185)")
+        print()
+    else:
+        print("perf_stage0: no log\n")
+
+    # 3. bench headline
+    for name, label in (("bench_stdout.log", "bench headline"),
+                        ("e2e10k.log", "e2e @10k int16"),
+                        ("e2e_joint.log", "e2e joint")):
+        txt = _read(name)
+        line = None
+        for ln in txt.splitlines():
+            if ln.startswith("{") and '"metric"' in ln:
+                line = ln
+        if not line:
+            print(f"{label}: no JSON\n")
+            continue
+        try:
+            d = json.loads(line)
+        except json.JSONDecodeError:
+            print(f"{label}: unparseable JSON\n")
+            continue
+        print(f"{label}:")
+        print(f"  value: {d.get('value'):.4g} {d.get('unit', '')} "
+              f"({d.get('vs_baseline')}x baseline)")
+        if "hbm_frac" in d:
+            print(f"  hbm: {d.get('hbm_gbps')} GB/s "
+                  f"({100 * d['hbm_frac']:.1f}% of peak; "
+                  "VERDICT r4 target: >=40% => >=60 G ch-samp/s)")
+            v = d.get("value", 0)
+            print(f"  vs r04 record 29.06e9: {v / 29.06e9:.2f}x")
+        if "engines" in d:
+            print(f"  engines: {d['engines']}")
+        if "int16" in d:
+            print(f"  int16: {d['int16']}")
+        if "phase_rates" in d:
+            print(f"  phase rates: {d['phase_rates']}")
+        if "error" in d:
+            print(f"  ERROR: {d['error']}")
+        print()
+
+    # 4. crossover
+    rt = _read("retune.log")
+    if rt:
+        tail = [ln for ln in rt.splitlines()
+                if "pallas win" in ln or "xla win" in ln
+                or "threshold" in ln]
+        print("pallas/xla crossover (retune _pallas_stage_ok):")
+        for ln in tail:
+            print("  " + ln.strip())
+        print()
+
+    # 5. HBM per window
+    hp = _read("hbm_probe.log")
+    if hp:
+        worst = re.search(r"worst measured processing factor: ([\d.]+)", hp)
+        print("hbm probe:")
+        for ln in hp.splitlines():
+            if ln.startswith("{"):
+                print("  " + ln.strip())
+        if worst:
+            print(f"  => worst factor {worst.group(1)} vs the memory "
+                  "model's 5 x 1.2 — fill PERF.md §7's table")
+        print()
+
+    print("next: bake winning defaults into tpudas/ops/pallas_fir.py, "
+          "retune _pallas_stage_ok if the crossover moved, update "
+          "PERF.md §3/§7, commit BENCH_r05_midround.json")
+
+
+if __name__ == "__main__":
+    main()
