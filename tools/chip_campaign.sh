@@ -33,6 +33,9 @@ timeout 900 python tools/chip_check.py 2>&1 | tee "$OUT/chip_check.log"
 echo "[$(stamp)] step 2: stage-0 geometry sweep"
 timeout 1200 python tools/perf_stage0.py 2>&1 | tee "$OUT/perf_stage0.log"
 
+echo "[$(stamp)] step 2b: P-stream DMA probe (pure copy, no compute)"
+timeout 900 python tools/probe_pipeline.py 2>&1 | tee "$OUT/probe_pipeline.log"
+
 echo "[$(stamp)] step 3: full bench (headline + engines + int16 + e2e@256)"
 # raise bench.py's internal watchdogs to match the outer timeout —
 # the defaults (540 s budget / 360 s child) would self-abort first
