@@ -62,9 +62,15 @@ def main():
     B = int(hb.shape[0])
     print(f"stage0: R={R} taps={len(h0)} B={B}", flush=True)
 
+    # STAGE0_QUICK=1 (the per-geometry-subprocess sweep mode of
+    # tools/chip_campaign2.sh) skips the read-ceiling and XLA
+    # reference sections so each subprocess spends its tunnel time on
+    # the one geometry it was asked for.
+    quick = os.environ.get("STAGE0_QUICK", "0") == "1"
     T0 = 129088
-    dt = measure(lambda x: jnp.sum(x, axis=0), T0)
-    report("read-ceiling (sum)", T0, dt)
+    if not quick:
+        dt = measure(lambda x: jnp.sum(x, axis=0), T0)
+        report("read-ceiling (sum)", T0, dt)
 
     # product kernel: (kb, cb) sweep; kb=512 is the product default
     # (P=4 parallel 128-frame sub-blocks per grid step).  Geometry
@@ -111,10 +117,11 @@ def main():
                   flush=True)
 
     # XLA polyphase reference
-    n_out = 16128
-    T = (n_out + B) * R
-    dt = measure(lambda x: _polyphase_stage_xla(x, hb, R, n_out), T)
-    report("xla polyphase", T, dt, 4.0, 2 * 4 / 8)
+    if not quick:
+        n_out = 16128
+        T = (n_out + B) * R
+        dt = measure(lambda x: _polyphase_stage_xla(x, hb, R, n_out), T)
+        report("xla polyphase", T, dt, 4.0, 2 * 4 / 8)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,35 @@
+#!/bin/bash
+# Watcher for the REORDERED campaign (tools/chip_campaign2.sh):
+# probe the tunneled backend until it answers, then immediately spend
+# the alive-window on the judge-critical artifacts (bench first).
+# campaign2 exits 0 only when ALL steps have .done markers, so a
+# mid-campaign tunnel wedge resumes watching and the next alive-window
+# picks up at the first incomplete step.
+cd "$(dirname "$0")/.."
+for i in $(seq 1 90); do
+  if timeout 120 python -c "
+import jax
+assert jax.default_backend() != 'cpu'
+import jax.numpy as jnp
+assert float((jnp.ones((128,128)) @ jnp.ones((128,128))).sum()) == 128.0*128*128
+print('TPU ALIVE:', jax.devices())
+" 2>/dev/null; then
+    echo "tpu up on probe $i at $(date -u +%H:%M:%S) — starting campaign2"
+    mkdir -p chip_r05
+    bash tools/chip_campaign2.sh 2>&1 | tee -a chip_r05/campaign2.log
+    rc=${PIPESTATUS[0]}
+    if [ "$rc" -eq 0 ]; then
+      echo "campaign2 complete at $(date -u +%H:%M:%S)"
+      exit 0
+    fi
+    # tunnel flapped mid-campaign: the probe WAS alive, so re-probe
+    # after a short breather rather than burning a full watch period
+    echo "campaign2 rc=$rc at $(date -u +%H:%M:%S) — re-probing shortly"
+    sleep 90
+    continue
+  fi
+  echo "probe $i: dead at $(date -u +%H:%M:%S)"
+  sleep 420
+done
+echo "gave up after $i probes"
+exit 1
