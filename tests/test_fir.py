@@ -164,6 +164,64 @@ class TestPallasKernel:
         with pytest.raises(ValueError, match="tap frames"):
             fir_decimate_pallas(x, hb, 2, n_out=64, interpret=True)
 
+    @pytest.mark.parametrize(
+        "env",
+        [
+            {"TPUDAS_PALLAS_GRID": "ck"},
+            {"TPUDAS_PALLAS_DIMSEM": "parallel"},
+            {"TPUDAS_PALLAS_DIMSEM": "arbitrary,parallel"},
+            {
+                "TPUDAS_PALLAS_GRID": "ck",
+                "TPUDAS_PALLAS_DIMSEM": "arbitrary,arbitrary",
+                "TPUDAS_PALLAS_VMEM_MB": "12",
+            },
+        ],
+    )
+    def test_mosaic_knob_variants_bit_equal(self, monkeypatch, env):
+        """The Mosaic experiment knobs (grid order, dimension
+        semantics, VMEM cap — swept on chip by chip_campaign2 step 5)
+        must not change kernel OUTPUT, only its schedule: every
+        variant is bit-equal to the default lowering."""
+        from tpudas.ops.pallas_fir import (
+            fir_decimate_pallas,
+            stage_input_rows,
+        )
+
+        rng = np.random.default_rng(3)
+        R, L, n_out = 8, 43, 512
+        B = -(-L // R)
+        hp = np.zeros(B * R, np.float32)
+        hp[:L] = rng.standard_normal(L).astype(np.float32)
+        hb = jnp.asarray(hp.reshape(B, R))
+        T = stage_input_rows(B, R, n_out, 512)
+        x = rng.standard_normal((T, 130)).astype(np.float32)
+        base = np.asarray(
+            fir_decimate_pallas(
+                jnp.asarray(x), hb, R, n_out, interpret=True,
+                kb=512, cb=128,
+            )
+        )
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+        got = np.asarray(
+            fir_decimate_pallas(
+                jnp.asarray(x), hb, R, n_out, interpret=True,
+                kb=512, cb=128,
+            )
+        )
+        np.testing.assert_array_equal(got, base)
+
+    def test_mosaic_knob_validation(self, monkeypatch):
+        from tpudas.ops.pallas_fir import _mosaic_knobs
+
+        monkeypatch.setenv("TPUDAS_PALLAS_GRID", "zz")
+        with pytest.raises(ValueError, match="TPUDAS_PALLAS_GRID"):
+            _mosaic_knobs()
+        monkeypatch.setenv("TPUDAS_PALLAS_GRID", "kc")
+        monkeypatch.setenv("TPUDAS_PALLAS_DIMSEM", "bogus")
+        with pytest.raises(ValueError, match="TPUDAS_PALLAS_DIMSEM"):
+            _mosaic_knobs()
+
     def test_env_geometry_knob_validation(self, monkeypatch):
         """TPUDAS_PALLAS_P/CB: empty means default; bad values fail
         fast naming the variable (not mid-run at a lazy import)."""

@@ -124,33 +124,65 @@ if [ ! -f "$OUT/step4.done" ]; then
   fi
 fi
 
-# geometry lists defined ONCE here; exported to perf_stage0.py (its
-# in-file defaults cover the plain no-env invocation)
-KBS="256 512 1024"
-CBS="128 256"
+# sweep rows: "kb cb [extra ENV=... assignments]".  Geometry rows map
+# the (kb, cb) grid space; tagged rows A/B the Mosaic knobs
+# (TPUDAS_PALLAS_DIMSEM / _GRID, tpudas/ops/pallas_fir.py) and the v1
+# kernel at the product geometry.  kb=128 is the true SINGLE-stream v2
+# (P=1): the standalone prototype measured 212-229 GB/s there while
+# chip_check r05 saw only ~185 at P=4 — this row decides whether
+# P-streaming helps, does nothing, or actively regresses the kernel.
+SWEEP_ROWS=(
+  "128 128"
+  "128 256"
+  "256 128"
+  "256 256"
+  "512 128"
+  "512 256"
+  "1024 128"
+  "1024 256"
+  "512 128 TPUDAS_PALLAS_DIMSEM=parallel,parallel"
+  "512 128 TPUDAS_PALLAS_DIMSEM=arbitrary,arbitrary"
+  "512 128 TPUDAS_PALLAS_GRID=ck"
+  "128 128 TPUDAS_PALLAS_GRID=ck"
+  "512 128 TPUDAS_PALLAS_IMPL=v1"
+)
+row_done() {  # row_done <kb> <cb> <envs> — has this row a result line?
+  # untagged labels are a string PREFIX of tagged ones, so the plain
+  # row must exclude bracketed (tagged) lines to avoid false skips
+  if [ -z "$3" ]; then
+    grep -F "f32 kb=$1 cb=$2" "$OUT/sweep.log" 2>/dev/null \
+      | grep -v '\[' | grep -q "G ch-samp"
+  else
+    grep -F "f32 kb=$1 cb=$2 [$3]" "$OUT/sweep.log" 2>/dev/null \
+      | grep -q "G ch-samp"
+  fi
+}
 if [ ! -f "$OUT/step5.done" ]; then
   gate "step 5"
-  echo "[$(stamp)] step 5: stage-0 sweep (one subprocess per geometry)"
+  echo "[$(stamp)] step 5: stage-0 sweep (one subprocess per row)"
   ALLOK=1
-  for kb in $KBS; do
-    for cb in $CBS; do
-      if grep -q "kb=$kb cb=$cb" "$OUT/sweep.log" 2>/dev/null \
-         && grep "kb=$kb cb=$cb" "$OUT/sweep.log" | grep -q "G ch-samp"; then
-        continue  # geometry already measured in a previous attempt
-      fi
-      gate "sweep kb=$kb cb=$cb"
-      echo "[$(stamp)] sweep kb=$kb cb=$cb" | tee -a "$OUT/sweep.log"
-      STAGE0_QUICK=1 STAGE0_KBS=$kb STAGE0_CBS=$cb PYTHONUNBUFFERED=1 \
-        timeout 420 python tools/perf_stage0.py 2>&1 \
-        | tee -a "$OUT/sweep.log"
-      grep "kb=$kb cb=$cb" "$OUT/sweep.log" | grep -q "G ch-samp" \
-        || ALLOK=0
-    done
+  for row in "${SWEEP_ROWS[@]}"; do
+    set -- $row; kb=$1; cb=$2; shift 2; envs="$*"
+    if row_done "$kb" "$cb" "$envs"; then
+      continue  # row already measured in a previous attempt
+    fi
+    gate "sweep kb=$kb cb=$cb $envs"
+    echo "[$(stamp)] sweep row: kb=$kb cb=$cb env='$envs'" \
+      | tee -a "$OUT/sweep.log"
+    env $envs STAGE0_TAG="$envs" STAGE0_QUICK=1 \
+      STAGE0_KBS=$kb STAGE0_CBS=$cb PYTHONUNBUFFERED=1 \
+      timeout 420 python tools/perf_stage0.py 2>&1 \
+      | tee -a "$OUT/sweep.log"
+    row_done "$kb" "$cb" "$envs" || ALLOK=0
   done
   if [ "$ALLOK" = 1 ]; then
     touch "$OUT/step5.done"
+    keep "Preserve stage-0 geometry sweep" "$OUT/sweep.log" \
+      "$OUT/step5.done" || true
+  else
+    keep "Preserve stage-0 geometry sweep (partial)" "$OUT/sweep.log" \
+      || true
   fi
-  keep "Preserve stage-0 geometry sweep" "$OUT/sweep.log" || true
 fi
 
 if [ ! -f "$OUT/step6.done" ]; then

@@ -82,6 +82,11 @@ def main():
     cbs = [int(v) for v in os.environ.get(
         "STAGE0_CBS", "128,256").split(",")]
     geoms = [(kb, cb) for kb in kbs for cb in cbs]
+    # STAGE0_TAG labels experiment rows (e.g. the Mosaic-knob A/Bs the
+    # campaign sweep runs via TPUDAS_PALLAS_* envs) so log lines from
+    # different configurations at the same geometry stay distinct
+    tag = os.environ.get("STAGE0_TAG", "").strip()
+    tag = f" [{tag}]" if tag else ""
     for kb, cb in geoms:
         n_out = -(-16000 // kb) * kb
         T = stage_input_rows(B, R, n_out, kb)
@@ -92,9 +97,11 @@ def main():
                 ),
                 T,
             )
-            report(f"pallas f32 kb={kb} cb={cb}", T, dt, 4.0, 2 * 4 / 8)
+            report(f"pallas f32 kb={kb} cb={cb}{tag}", T, dt,
+                   4.0, 2 * 4 / 8)
         except Exception as exc:
-            print(f"pallas kb={kb} cb={cb}: {str(exc)[:120]}", flush=True)
+            print(f"pallas kb={kb} cb={cb}{tag}: {str(exc)[:120]}",
+                  flush=True)
 
     # raw int16 payload (the quantized tdas ingest): half the read —
     # swept over the same geometries (the winning f32 geometry is not
@@ -111,9 +118,10 @@ def main():
                 T,
                 dtype="int16",
             )
-            report(f"pallas i16 kb={kb} cb={cb}", T, dt, 2.0, 2 * 4 / 8)
+            report(f"pallas i16 kb={kb} cb={cb}{tag}", T, dt,
+                   2.0, 2 * 4 / 8)
         except Exception as exc:
-            print(f"pallas i16 kb={kb} cb={cb}: {str(exc)[:120]}",
+            print(f"pallas i16 kb={kb} cb={cb}{tag}: {str(exc)[:120]}",
                   flush=True)
 
     # XLA polyphase reference

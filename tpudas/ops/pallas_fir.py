@@ -87,6 +87,50 @@ _KB = _SB * _P  # output frames per grid step (the grid quantum)
 _CB = _env_geom("TPUDAS_PALLAS_CB", 128, multiple_of=128)  # channel block
 
 
+def _mosaic_knobs():
+    """Experimental Mosaic/pipeline knobs for on-chip sweeps (read at
+    call time so one process can A/B them without reimport):
+
+    - TPUDAS_PALLAS_DIMSEM: dimension_semantics for the (k, c) grid —
+      "parallel", "arbitrary", or a comma pair like
+      "arbitrary,parallel" (order follows the ACTIVE grid order).
+    - TPUDAS_PALLAS_GRID: "kc" (default; channel block varies fastest)
+      or "ck" (output-frame block varies fastest, so consecutive grid
+      steps walk sequential rows of the input).
+    - TPUDAS_PALLAS_VMEM_MB: vmem_limit_bytes override, in MiB —
+      larger double-buffering headroom for big-block geometries.
+
+    Defaults leave everything unset: identical behavior/lowering to
+    the kernel that passed chip_check (chip_r05/chip_check.log).
+    """
+    sems_env = os.environ.get("TPUDAS_PALLAS_DIMSEM", "").strip()
+    grid_order = os.environ.get("TPUDAS_PALLAS_GRID", "kc").strip() or "kc"
+    if grid_order not in ("kc", "ck"):
+        raise ValueError(
+            f"TPUDAS_PALLAS_GRID must be 'kc' or 'ck', got {grid_order!r}"
+        )
+    vmem_mb = _env_geom("TPUDAS_PALLAS_VMEM_MB", 0)  # 0 = unset
+    cp_kwargs = {}
+    if sems_env:
+        sems = tuple(s.strip() for s in sems_env.split(","))
+        if len(sems) == 1:
+            sems = sems * 2
+        if len(sems) != 2 or not all(
+            s in ("parallel", "arbitrary") for s in sems
+        ):
+            raise ValueError(
+                "TPUDAS_PALLAS_DIMSEM must be 'parallel', 'arbitrary' "
+                f"or a comma pair of those, got {sems_env!r}"
+            )
+        cp_kwargs["dimension_semantics"] = sems
+    if vmem_mb:
+        cp_kwargs["vmem_limit_bytes"] = vmem_mb * 2**20
+    call_kwargs = {}
+    if cp_kwargs:
+        call_kwargs["compiler_params"] = pltpu.CompilerParams(**cp_kwargs)
+    return grid_order, call_kwargs
+
+
 def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
@@ -306,35 +350,51 @@ def fir_decimate_pallas(
     halo_rows = halo_f * R
     step = SB * P // halo_f  # halo offset in halo-block units
 
+    grid_order, call_kwargs = _mosaic_knobs()
+    if grid_order == "ck":
+        # grid (nc, nk): index-map args arrive as (c, k) — remap to
+        # the (k, c) the block coordinates are written in
+        grid = (nc, nk)
+
+        def _km(f):
+            return lambda c, k, _f=f: _f(k, c)
+
+    else:
+        grid = (nk, nc)
+
+        def _km(f):
+            return f
+
     main_specs = [
         pl.BlockSpec(
             (SB * R, CB),
-            (lambda k, c, j=j: (k * P + j, c)),
+            _km(lambda k, c, j=j: (k * P + j, c)),
             memory_space=pltpu.VMEM,
         )
         for j in range(P)
     ]
     halo_spec = pl.BlockSpec(
         (halo_rows, CB),
-        lambda k, c, _s=step: (k * _s + _s, c),
+        _km(lambda k, c, _s=step: (k * _s + _s, c)),
         memory_space=pltpu.VMEM,
     )
     out = pl.pallas_call(
         _kernel_body(P, SB, CB, halo_rows, exact=interpret),
-        grid=(nk, nc),
+        grid=grid,
         in_specs=[
             pl.BlockSpec(
                 (SB, band_rows),
-                lambda k, c: (0, 0),
+                _km(lambda k, c: (0, 0)),
                 memory_space=pltpu.VMEM,
             ),
             *main_specs,
             halo_spec,
         ],
         out_specs=pl.BlockSpec(
-            (KB, CB), lambda k, c: (k, c), memory_space=pltpu.VMEM
+            (KB, CB), _km(lambda k, c: (k, c)), memory_space=pltpu.VMEM
         ),
         out_shape=jax.ShapeDtypeStruct((Kpad, nc * CB), jnp.float32),
         interpret=interpret,
+        **call_kwargs,
     )(A, *([x2] * P), x2)
     return out[:n_out, :C]
