@@ -185,6 +185,18 @@ if [ ! -f "$OUT/step5.done" ]; then
   fi
 fi
 
+if [ ! -f "$OUT/step5b.done" ]; then
+  gate "step 5b"
+  echo "[$(stamp)] step 5b: pure-XLA conv formulations of stage 0"
+  STAGE0_CONV=1 PYTHONUNBUFFERED=1 timeout 420 \
+    python tools/perf_stage0.py 2>&1 | tee -a "$OUT/sweep.log"
+  if grep "conv-" "$OUT/sweep.log" | grep -q "G ch-samp"; then
+    touch "$OUT/step5b.done"
+    keep "Preserve XLA-conv stage-0 measurement" "$OUT/sweep.log" \
+      "$OUT/step5b.done" || true
+  fi
+fi
+
 if [ ! -f "$OUT/step6.done" ]; then
   gate "step 6"
   echo "[$(stamp)] step 6: pallas-vs-xla crossover (retune _pallas_stage_ok)"
@@ -198,7 +210,7 @@ if [ ! -f "$OUT/step6.done" ]; then
 fi
 
 MISSING=0
-for n in 1 2 3 4 5 6; do
+for n in 1 2 3 4 5 5b 6; do
   [ -f "$OUT/step$n.done" ] || { echo "step $n incomplete"; MISSING=1; }
 done
 echo "[$(stamp)] campaign2 pass finished — logs in $OUT/"

@@ -72,6 +72,52 @@ def main():
         dt = measure(lambda x: jnp.sum(x, axis=0), T0)
         report("read-ceiling (sum)", T0, dt)
 
+    # STAGE0_CONV=1: measure the pure-XLA conv formulations of stage 0
+    # instead of the Pallas geometries — if XLA's native conv emitter
+    # streams anywhere near the ~510 GB/s its reduce does, it beats
+    # the Pallas path without any Mosaic tuning.  Two mappings of the
+    # same depthwise-with-shared-taps op (taps identical per channel):
+    #   conv-batch:     channels as the conv BATCH dim (N=C, feat=1)
+    #   conv-depthwise: channels as grouped FEATURES (groups=C)
+    if os.environ.get("STAGE0_CONV", "0") == "1":
+        taps_full = jnp.asarray(np.asarray(hb, np.float32).reshape(-1))
+        L = int(taps_full.shape[0])
+        n_out = 16128
+        T = (n_out - 1) * R + L
+
+        def conv_batch(x, _t=taps_full, _R=R, _n=n_out, _L=L):
+            lhs = x.T[:, None, :]  # (C, 1, T): N=C, feature=1
+            rhs = _t[None, None, :]  # (O=1, I=1, L)
+            y = jax.lax.conv_general_dilated(
+                lhs, rhs, window_strides=(_R,), padding="VALID",
+                dimension_numbers=("NCH", "OIH", "NCH"),
+            )
+            return y[:, 0, :_n].T
+
+        def conv_depthwise(x, _t=taps_full, _R=R, _n=n_out):
+            Cx = x.shape[1]
+            lhs = x.T[None, :, :]  # (1, C, T)
+            rhs = jnp.broadcast_to(
+                taps_full[None, None, :], (Cx, 1, taps_full.shape[0])
+            )
+            y = jax.lax.conv_general_dilated(
+                lhs, rhs, window_strides=(_R,), padding="VALID",
+                dimension_numbers=("NCH", "OIH", "NCH"),
+                feature_group_count=Cx,
+            )
+            return y[0, :, :_n].T
+
+        for name, fn in (
+            ("conv-batch", conv_batch),
+            ("conv-depthwise", conv_depthwise),
+        ):
+            try:
+                dt = measure(fn, T)
+                report(f"{name} f32", T, dt, 4.0, 2 * 4 / 8)
+            except Exception as exc:
+                print(f"{name} f32: {str(exc)[:120]}", flush=True)
+        return
+
     # product kernel: (kb, cb) sweep; kb=512 is the product default
     # (P=4 parallel 128-frame sub-blocks per grid step).  Geometry
     # lists are env-overridable so a live session can widen or narrow
