@@ -58,19 +58,26 @@ def main() -> None:
     else:
         print("chip_check: no log\n")
 
-    # 2. stage-0 sweep: best geometry per payload
-    ps = _read("perf_stage0.log")
-    if ps:
+    # 2. stage-0 sweep: best geometry per payload.  Reads both the
+    # campaign1 single-log form (perf_stage0.log) and the campaign2
+    # tagged per-row form (sweep.log); tagged rows carry the knob/impl
+    # experiment envs in [brackets] and are ranked separately from the
+    # plain geometry rows (only the latter drive the bake line).
+    ps = _read("perf_stage0.log") + "\n" + _read("sweep.log")
+    if ps.strip():
         best: dict = {}
+        best_tagged: dict = {}
         for m in re.finditer(
-            r"pallas (f32|i16) kb=(\d+) cb=(\d+)\s+[\d.]+ ms/win\s+"
+            r"pallas (f32|i16) kb=(\d+) cb=(\d+)(?: \[([^\]]*)\])?"
+            r"\s+[\d.]+ ms/win\s+"
             r"([\d.]+) G ch-samp/s\s+([\d.]+) GB/s",
             ps,
         ):
-            pay, kb, cb, gsps, gbps = m.groups()
-            rec = (float(gsps), int(kb), int(cb), float(gbps))
-            if pay not in best or rec > best[pay]:
-                best[pay] = rec
+            pay, kb, cb, tag, gsps, gbps = m.groups()
+            rec = (float(gsps), int(kb), int(cb), float(gbps), tag or "")
+            target = best_tagged if tag else best
+            if pay not in target or rec[0] > target[pay][0]:
+                target[pay] = rec
         ceiling = re.search(
             r"read-ceiling \(sum\)\s+[\d.]+ ms/win\s+[\d.]+ G ch-samp/s"
             r"\s+([\d.]+) GB/s", ps,
@@ -78,19 +85,33 @@ def main() -> None:
         print("stage-0 sweep:")
         if ceiling:
             print(f"  harness read ceiling: {ceiling.group(1)} GB/s")
-        for pay, (gsps, kb, cb, gbps) in sorted(best.items()):
+        for pay, (gsps, kb, cb, gbps, _) in sorted(best.items()):
             print(f"  best {pay}: kb={kb} cb={cb} -> {gsps:.2f} G "
                   f"ch-samp/s ({gbps:.0f} GB/s)")
+        for pay, (gsps, kb, cb, gbps, tag) in sorted(best_tagged.items()):
+            print(f"  best tagged {pay}: kb={kb} cb={cb} [{tag}] -> "
+                  f"{gsps:.2f} G ch-samp/s ({gbps:.0f} GB/s)")
+        for m in re.finditer(
+            r"(conv-\w+) f32\s+[\d.]+ ms/win\s+([\d.]+) G ch-samp/s"
+            r"\s+([\d.]+) GB/s", ps,
+        ):
+            print(f"  {m.group(1)}: {m.group(2)} G ch-samp/s "
+                  f"({m.group(3)} GB/s)")
         if "f32" in best:
-            _, kb, cb, gbps = best["f32"]
-            print(f"  => bake: TPUDAS_PALLAS_P={kb // 128} "
+            gsps, kb, cb, gbps, _ = best["f32"]
+            print(f"  => bake: TPUDAS_PALLAS_P={max(kb // 128, 1)} "
                   f"TPUDAS_PALLAS_CB={cb}")
+            if "f32" in best_tagged and best_tagged["f32"][0] > gsps:
+                tg = best_tagged["f32"]
+                print(f"  => NOTE: tagged row [{tg[4]}] beats every "
+                      f"plain geometry ({tg[0]:.2f} > {gsps:.2f} G) — "
+                      "consider baking that knob as default")
             print(f"  => P-stream hypothesis "
                   f"{'HOLDS' if gbps > 230 else 'does NOT hold'} "
                   f"(target >230 GB/s; single-stream wall ~185)")
         print()
     else:
-        print("perf_stage0: no log\n")
+        print("perf_stage0/sweep: no log\n")
 
     # 3. bench headline
     for name, label in (("bench_stdout.log", "bench headline"),
