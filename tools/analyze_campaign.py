@@ -42,6 +42,9 @@ def main() -> None:
         print("chip_check:")
         for ln in oks + fails:
             print("  " + ln.strip())
+        for ln in cc.splitlines():
+            if re.match(r"stage0 (f32|i16):", ln.strip()):
+                print("  " + ln.strip())
         if "Mosaic is NOT exercised" in cc or "backend=cpu" in cc:
             # interpret-mode numbers say nothing about the compiled
             # kernel — never report a Mosaic verdict off them
