@@ -1,0 +1,86 @@
+"""Tests for the campaign analysis tooling (tools/analyze_campaign.py).
+
+The digest is what turns a scarce alive-window's logs into decisions
+(winning geometry, Mosaic verdict, knob-vs-plain ranking), so its
+parsing of the campaign2 formats — tagged sweep rows, conv rows, the
+prefix relationship between tagged and untagged labels — is pinned
+here.
+"""
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SWEEP = """\
+[05:00:00] sweep row: kb=128 cb=128 env=''
+pallas f32 kb=128 cb=128              4.000 ms/win   50.00 G ch-samp/s  250.0 GB/s (30.5% peak)
+pallas i16 kb=128 cb=128              3.000 ms/win   66.00 G ch-samp/s  200.0 GB/s (24.4% peak)
+[05:05:00] sweep row: kb=512 cb=128 env='TPUDAS_PALLAS_GRID=ck'
+pallas f32 kb=512 cb=128 [TPUDAS_PALLAS_GRID=ck]    3.500 ms/win   55.00 G ch-samp/s  275.0 GB/s (33.6% peak)
+pallas f32 kb=512 cb=128              9.000 ms/win   20.00 G ch-samp/s  100.0 GB/s (12.2% peak)
+conv-batch f32                        2.000 ms/win  100.00 G ch-samp/s  500.0 GB/s (61.1% peak)
+conv-depthwise f32: error: grouped conv not supported
+"""
+
+
+def _digest(tmp_path, files):
+    for name, content in files.items():
+        (tmp_path / name).write_text(content)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "analyze_campaign.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    return proc.stdout
+
+
+class TestAnalyzeCampaign:
+    def test_tagged_rows_ranked_separately_from_plain(self, tmp_path):
+        out = _digest(tmp_path, {"sweep.log": SWEEP})
+        # plain best must be the untagged kb=128 row, NOT the tagged
+        # kb=512 row (55 G) that numerically beats it
+        assert "best f32: kb=128 cb=128 -> 50.00 G" in out
+        assert "best i16: kb=128 cb=128 -> 66.00 G" in out
+        assert ("best tagged f32: kb=512 cb=128 [TPUDAS_PALLAS_GRID=ck] "
+                "-> 55.00 G") in out
+        # a winning tagged row triggers the bake-the-knob note
+        assert "beats every plain geometry" in out
+
+    def test_conv_rows_reported(self, tmp_path):
+        out = _digest(tmp_path, {"sweep.log": SWEEP})
+        assert "conv-batch: 100.00 G ch-samp/s" in out
+        # failed conv rows (no rate line) are simply absent
+        assert "conv-depthwise:" not in out
+
+    def test_bake_line_handles_single_stream(self, tmp_path):
+        out = _digest(tmp_path, {"sweep.log": SWEEP})
+        # kb=128 winner -> P=1 (not 0)
+        assert "TPUDAS_PALLAS_P=1" in out
+        assert "TPUDAS_PALLAS_CB=128" in out
+
+    def test_chip_check_rates_surfaced(self, tmp_path):
+        cc = (
+            "backend=tpu\n"
+            "stage0 pallas-vs-xla rel err: 5.16e-06 (OK)\n"
+            "stage0 f32: 7.251 ms/win  37.04 G ch-samp/s  ~185 GB/s\n"
+            "stage0 i16: 5.282 ms/win  50.85 G ch-samp/s\n"
+            "chip_check done\n"
+        )
+        out = _digest(tmp_path, {"chip_check.log": cc})
+        assert "v2 Mosaic verdict: ACCEPTED" in out
+        assert "stage0 f32: 7.251 ms/win" in out
+
+    def test_cpu_run_never_yields_mosaic_verdict(self, tmp_path):
+        cc = (
+            "backend=cpu\n"
+            "stage0 pallas-vs-xla rel err: 0.00e+00 (OK)\n"
+            "chip_check done (cpu: rate section skipped)\n"
+        )
+        out = _digest(tmp_path, {"chip_check.log": cc})
+        assert "UNTESTED" in out
