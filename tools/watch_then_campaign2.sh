@@ -6,7 +6,15 @@
 # mid-campaign tunnel wedge resumes watching and the next alive-window
 # picks up at the first incomplete step.
 cd "$(dirname "$0")/.."
+# Expire well before the round driver's own end-of-round bench run: a
+# campaign starting late would hold a second tunnel client open during
+# the official BENCH_r05.json capture.  Override: WATCH_EXPIRE_AT=<epoch>.
+EXPIRE_AT=${WATCH_EXPIRE_AT:-$(( $(date +%s) + 28800 ))}  # 8h default
 for i in $(seq 1 90); do
+  if [ "$(date +%s)" -ge "$EXPIRE_AT" ]; then
+    echo "watch window expired at $(date -u +%H:%M:%S) — exiting"
+    exit 1
+  fi
   if timeout 120 python -c "
 import jax
 assert jax.default_backend() != 'cpu'
