@@ -5,17 +5,29 @@
 # campaign2 exits 0 only when ALL steps have .done markers, so a
 # mid-campaign tunnel wedge resumes watching and the next alive-window
 # picks up at the first incomplete step.
+#
+# Probe cadence: LONG quiet periods with backoff.  Wedge forensics
+# (NOTES_r05): in 12 h of history the tunnel recovered exactly once —
+# during the only probe-free hour — while 10+ h of 9-minute probing
+# never saw a recovery.  If killed probe clients reset the server's
+# cleanup, frequent probing PREVENTS recovery; the quiet-period
+# schedule bets on that mechanism while still catching a scheduled
+# restart within ~40 min.  Probe timeout is 45 s (healthy init takes
+# 8-12 s) so a doomed probe holds its connection as briefly as
+# possible.
 cd "$(dirname "$0")/.."
 # Expire well before the round driver's own end-of-round bench run: a
 # campaign starting late would hold a second tunnel client open during
 # the official BENCH_r05.json capture.  Override: WATCH_EXPIRE_AT=<epoch>.
 EXPIRE_AT=${WATCH_EXPIRE_AT:-$(( $(date +%s) + 28800 ))}  # 8h default
+SLEEPS=(420 420 900 1500 2400)
+si=0
 for i in $(seq 1 90); do
   if [ "$(date +%s)" -ge "$EXPIRE_AT" ]; then
     echo "watch window expired at $(date -u +%H:%M:%S) — exiting"
     exit 1
   fi
-  if timeout 120 python -c "
+  if timeout 45 python -c "
 import jax
 assert jax.default_backend() != 'cpu'
 import jax.numpy as jnp
@@ -31,13 +43,16 @@ print('TPU ALIVE:', jax.devices())
       exit 0
     fi
     # tunnel flapped mid-campaign: the probe WAS alive, so re-probe
-    # after a short breather rather than burning a full watch period
+    # after a short breather, then fall back into the quiet schedule
     echo "campaign2 rc=$rc at $(date -u +%H:%M:%S) — re-probing shortly"
+    si=0
     sleep 90
     continue
   fi
-  echo "probe $i: dead at $(date -u +%H:%M:%S)"
-  sleep 420
+  d=${SLEEPS[$si]}
+  [ "$si" -lt $(( ${#SLEEPS[@]} - 1 )) ] && si=$(( si + 1 ))
+  echo "probe $i: dead at $(date -u +%H:%M:%S); quiet ${d}s"
+  sleep "$d"
 done
 echo "gave up after $i probes"
 exit 1
