@@ -702,7 +702,15 @@ def _child() -> None:
         kernel, flops_win = _build_fft_step(T, C, fs, dt_out, order)
         T_used = T
 
-    pallas_impl = None
+    # explicit verdict field: which Pallas implementation the headline
+    # actually ran (VERDICT r4 item 1 wants this IN the artifact, not
+    # inferred from the absence of pallas_error).  Overwritten to "v1"
+    # if the fallback tier fires below; dropped when pallas didn't run.
+    pallas_impl = (
+        os.environ.get("TPUDAS_PALLAS_IMPL", "v2")
+        if engine == "cascade" and use_pallas
+        else None
+    )
     try:
         elapsed, iters_done, n_resident = _measure(
             kernel, T_used, C, iters, include_h2d
@@ -755,6 +763,7 @@ def _child() -> None:
                 flush=True,
             )
             use_pallas = False
+            pallas_impl = None  # the headline below is the XLA tier
             kernel, flops_win, T_used, report = _build_cascade_step(
                 T, C, fs, dt_out, order, False, mesh, time_shards
             )

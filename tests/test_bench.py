@@ -136,6 +136,31 @@ class TestCompareVisibility:
         assert result["engine"] == "cascade"
         assert "mosaic compile failure" in result["pallas_error"]
 
+    def test_clean_pallas_run_reports_impl_v2(self, monkeypatch, capsys):
+        """A clean Pallas headline carries the explicit implementation
+        verdict (pallas_impl: v2, no pallas_error) — VERDICT r4 item 1
+        wants the verdict readable from the artifact alone."""
+        import tpudas.ops.fir as fir_mod
+
+        monkeypatch.delenv("TPUDAS_PALLAS_IMPL", raising=False)
+        fir_mod._layout_for.cache_clear()
+        fir_mod._clear_cascade_caches()
+        monkeypatch.setattr(
+            fir_mod, "_pallas_stage_ok",
+            lambda k, R, n_ch, B: k >= 3000 and B <= 128,
+        )
+        try:
+            result = _run_child(
+                monkeypatch, capsys, BENCH_PALLAS="1", BENCH_COMPARE="0",
+                BENCH_QUANT="0",
+            )
+        finally:
+            fir_mod._layout_for.cache_clear()
+            fir_mod._clear_cascade_caches()
+        assert result["value"] > 0
+        assert result["pallas_impl"] == "v2"
+        assert "pallas_error" not in result
+
     def test_pallas_v2_failure_lands_on_v1(self, monkeypatch, capsys):
         """When only the v2 kernel body fails, the bench headline runs
         on the v1 Pallas implementation, not the XLA downgrade."""
