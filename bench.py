@@ -971,6 +971,65 @@ def _child() -> None:
             )
         result["engines"] = engines
 
+    # Optional in-process stage-0 mini-sweep (BENCH_SWEEP=1, campaign2
+    # step 1): the rows that decide the P-stream question, run INSIDE
+    # the headline child because the wedge forensics (NOTES_r05) show
+    # process 2 of an alive-window historically never gets to run.
+    # Full geometry/knob coverage stays in tools/perf_stage0.py.
+    if os.environ.get("BENCH_SWEEP", "0") == "1":
+        if backend == "cpu" and "BENCH_SWEEP_FORCE" not in os.environ:
+            result["sweep"] = {"skipped": "cpu"}
+        else:
+            from tpudas.ops.fir import _block_taps
+            from tpudas.ops.fir import design_cascade as _dc
+            from tpudas.ops.pallas_fir import (
+                fir_decimate_pallas,
+                stage_input_rows,
+            )
+
+            plan0 = _dc(fs, int(round(fs * dt_out)), 0.45, order)
+            R0, h0 = plan0.stages[0]
+            hb0 = np.asarray(_block_taps(np.asarray(h0), R0))
+            B0 = int(hb0.shape[0])
+            n0 = 16384
+            sweep = {}
+            rows = (
+                ("v2_kb128_p1", 128, {}),
+                ("v2_kb512_p4", 512, {}),
+                ("v2_kb512_ck", 512, {"TPUDAS_PALLAS_GRID": "ck"}),
+                ("v1", 512, {"TPUDAS_PALLAS_IMPL": "v1"}),
+            )
+            for name, kb, envs in rows:
+                if remaining - (time.monotonic() - child_start) < 150:
+                    sweep[name] = "skipped: budget"
+                    continue
+                t_in = stage_input_rows(B0, R0, n0, kb)
+                old = {k: os.environ.get(k) for k in envs}
+                os.environ.update(envs)
+                try:
+                    dt, n_done, _ = _measure(
+                        lambda w, _kb=kb: fir_decimate_pallas(
+                            w, hb0, R0, n_out=n0, kb=_kb
+                        ),
+                        t_in, C, 32, False,
+                    )
+                    rate = t_in * C * n_done / dt
+                    sweep[name] = {
+                        "ch_samp_per_s": round(rate, 1),
+                        "gbps": round(rate * 5.0 / 1e9, 1),
+                    }
+                except Exception as exc:
+                    sweep[name] = f"error: {exc}"[:120]
+                finally:
+                    for k, v in old.items():
+                        if v is None:
+                            os.environ.pop(k, None)
+                        else:
+                            os.environ[k] = v
+                print(f"[bench] sweep {name}: {sweep[name]}",
+                      file=sys.stderr, flush=True)
+            result["sweep"] = sweep
+
     print(json.dumps(result))
 
 

@@ -136,6 +136,16 @@ class TestCompareVisibility:
         assert result["engine"] == "cascade"
         assert "mosaic compile failure" in result["pallas_error"]
 
+    def test_sweep_skipped_on_cpu_but_recorded(self, monkeypatch, capsys):
+        """BENCH_SWEEP=1 on a CPU backend must not attempt the
+        interpret-mode sweep (hours at these shapes) but the request
+        must stay visible in the artifact."""
+        result = _run_child(
+            monkeypatch, capsys, BENCH_SWEEP="1", BENCH_COMPARE="0",
+            BENCH_QUANT="0",
+        )
+        assert result["sweep"] == {"skipped": "cpu"}
+
     def test_clean_pallas_run_reports_impl_v2(self, monkeypatch, capsys):
         """A clean Pallas headline carries the explicit implementation
         verdict (pallas_impl: v2, no pallas_error) — VERDICT r4 item 1

@@ -63,8 +63,9 @@ fi
 
 if [ ! -f "$OUT/step1.done" ]; then
   echo "[$(stamp)] step 1: full bench (headline + engines + int16 + e2e@256)"
-  BENCH_PROFILE=1 BENCH_BUDGET=1700 BENCH_CHILD_TIMEOUT=1500 \
-    BENCH_E2E_TIMEOUT=400 PYTHONUNBUFFERED=1 timeout 1800 python bench.py \
+  BENCH_PROFILE=1 BENCH_SWEEP=1 BENCH_BUDGET=2300 \
+    BENCH_CHILD_TIMEOUT=2100 BENCH_E2E_TIMEOUT=400 PYTHONUNBUFFERED=1 \
+    timeout 2400 python bench.py \
     2>"$OUT/bench_stderr.log" | tee "$OUT/bench_stdout.log"
   LINE=$(grep -E '^\{.*"metric"' "$OUT/bench_stdout.log" | tail -1)
   if [ -n "$LINE" ] && echo "$LINE" | python -c '
