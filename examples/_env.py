@@ -1,0 +1,14 @@
+"""Honor ``JAX_PLATFORMS=cpu`` even under a hosting sitecustomize that
+pre-registers a TPU plugin in every interpreter: when the tunnel
+behind that plugin is wedged, backend discovery hangs BEFORE the env
+var is consulted, so the config must be flipped explicitly (same
+mechanism as the repo conftest uses for the test suite).  Imported for
+its side effect by every example script.
+"""
+
+import os
+
+if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
