@@ -211,6 +211,20 @@ class TestPallasKernel:
         )
         np.testing.assert_array_equal(got, base)
 
+    def test_stage_ok_min_elems_env_override(self, monkeypatch):
+        """TPUDAS_PALLAS_MIN_ELEMS applies a measured crossover
+        without a code edit (tools/retune_stage_ok.py's output)."""
+        from tpudas.ops.fir import _pallas_stage_ok
+        from tpudas.ops.pallas_fir import _KB
+
+        k, R, n_ch, B = _KB, 8, 128, 6  # k*R*n_ch = 2**19: below 2**24
+        monkeypatch.delenv("TPUDAS_PALLAS_MIN_ELEMS", raising=False)
+        assert not _pallas_stage_ok(k, R, n_ch, B)
+        monkeypatch.setenv("TPUDAS_PALLAS_MIN_ELEMS", str(1 << 19))
+        assert _pallas_stage_ok(k, R, n_ch, B)
+        monkeypatch.setenv("TPUDAS_PALLAS_MIN_ELEMS", str(1 << 20))
+        assert not _pallas_stage_ok(k, R, n_ch, B)
+
     def test_mosaic_knob_validation(self, monkeypatch):
         from tpudas.ops.pallas_fir import _mosaic_knobs
 

@@ -93,8 +93,9 @@ def main() -> None:
     if loses:
         print(f"largest xla win:     {loses[-1]} elements "
               f"(2**{np.log2(loses[-1]):.1f})")
-    print("current threshold:   2**24 — adjust _pallas_stage_ok "
-          "(tpudas/ops/fir.py) if the crossover moved")
+    print("current threshold:   2**24 — if the crossover moved, set "
+          "TPUDAS_PALLAS_MIN_ELEMS (live override) and/or adjust "
+          "_pallas_stage_ok (tpudas/ops/fir.py)")
 
 
 if __name__ == "__main__":

@@ -321,11 +321,19 @@ def _pallas_stage_ok(k: int, R: int, n_ch: int, n_frames: int) -> bool:
     >= 2^24 elements touched and a full first grid step. Taps must
     also fit the kernel's sub-block; very long single-stage plans
     (possible via the public design API) take the XLA polyphase path
-    instead of erroring."""
+    instead of erroring.
+
+    ``TPUDAS_PALLAS_MIN_ELEMS`` overrides the element threshold so a
+    measured crossover (``tools/retune_stage_ok.py``) can be applied
+    on a live chip without a code edit."""
+    import os
+
     from tpudas.ops.pallas_fir import _KB, _SB
 
+    raw = os.environ.get("TPUDAS_PALLAS_MIN_ELEMS", "").strip()
+    min_elems = int(raw) if raw else (1 << 24)
     return (
-        k * R * n_ch >= (1 << 24)
+        k * R * n_ch >= min_elems
         and k >= _KB
         and n_frames <= _SB
     )
