@@ -20,8 +20,19 @@ cd "$(dirname "$0")/.."
 # campaign starting late would hold a second tunnel client open during
 # the official BENCH_r05.json capture.  Override: WATCH_EXPIRE_AT=<epoch>.
 EXPIRE_AT=${WATCH_EXPIRE_AT:-$(( $(date +%s) + 28800 ))}  # 8h default
-SLEEPS=(420 420 900 1500 2400)
+# Quiet schedule: the only observed recovery in 13+ h of wedge history
+# followed a ~76-minute probe-free gap, while 9-minute and 40-minute
+# cadences never saw one — so the steady state is 75-minute quiets
+# (override: WATCH_SLEEPS="s1 s2 ...").
+SLEEPS=(${WATCH_SLEEPS:-420 900 2400 4500 4500})
 si=0
+# WATCH_DELAY_FIRST: seconds of quiet BEFORE the first probe — lets a
+# restarted watcher finish out the quiet period already in progress
+# instead of resetting it with an immediate probe.
+if [ -n "${WATCH_DELAY_FIRST:-}" ]; then
+  echo "initial quiet ${WATCH_DELAY_FIRST}s before first probe"
+  sleep "$WATCH_DELAY_FIRST"
+fi
 for i in $(seq 1 90); do
   if [ "$(date +%s)" -ge "$EXPIRE_AT" ]; then
     echo "watch window expired at $(date -u +%H:%M:%S) — exiting"
