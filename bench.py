@@ -1034,6 +1034,22 @@ def _child() -> None:
 
 
 def main():
+    if os.environ.get("BENCH_MODE") == "stream":
+        # steady-state streaming bench (stateful carry vs edge-buffer
+        # rewind): pure CPU, no TPU tunnel involved — run it directly
+        # in a pinned-CPU subprocess so a tunnel-wedged backend can
+        # never stall the redundancy measurement
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        tool = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "tools",
+            "stream_bench.py",
+        )
+        args = [sys.executable, tool]
+        out = os.environ.get("BENCH_STREAM_OUT")
+        if out:
+            args += ["--out", out]
+        sys.exit(subprocess.call(args, env=env))
     if os.environ.get("BENCH_CHILD") == "1":
         _child()
     else:

@@ -26,9 +26,10 @@ def main():
     import jax
     import jax.numpy as jnp
     import numpy as np
-    from jax import shard_map
     from jax.experimental import multihost_utils
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from tpudas.parallel.compat import shard_map
 
     from tpudas.parallel.halo import exchange_halo_time
 

@@ -9,7 +9,11 @@ tdas round-trip including int16 quantization error bounds (SURVEY.md
 
 import numpy as np
 import pytest
-from hypothesis import given, settings, strategies as st
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property sweeps need the hypothesis extra"
+)
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from tpudas.proc.lfproc import schedule_windows
 from tpudas.proc.naming import get_filename, get_timestr

@@ -1,0 +1,501 @@
+"""Stateful streaming: the carried-filter-state execution mode.
+
+Pins the PR-01 contracts:
+- ops level: round-by-round stateful output equals the one-shot batch
+  path across block boundaries, for BOTH engines (FIR cascade carry and
+  FFT overlap-save carry);
+- proc level: LFProc's resumable stream path matches the batch oracle
+  and resumes seam-free from a serialized carry without rewinding;
+- driver level: run_lowpass_realtime's stateful mode matches rewind
+  mode numerically, eliminates the redundant re-reads the rewind pays,
+  and survives kill/resume on O(1) state.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tpudas.io.spool import spool
+from tpudas.core.timeutils import to_datetime64
+from tpudas.io.registry import write_patch
+from tpudas.proc.lfproc import LFProc
+from tpudas.proc.streaming import run_lowpass_realtime
+from tpudas.testing import make_synthetic_spool, synthetic_patch
+
+FS = 100.0
+FILE_SEC = 30.0
+NCH = 6
+T0 = np.datetime64("2023-03-22T00:00:00")
+
+
+def _append_files(directory, start_index, count):
+    t0 = T0.astype("datetime64[ns]")
+    step = np.timedelta64(int(round(1e9 / FS)), "ns")
+    n = int(FILE_SEC * FS)
+    for i in range(start_index, start_index + count):
+        p = synthetic_patch(
+            t0=t0 + i * n * step, duration=FILE_SEC, fs=FS, n_ch=NCH,
+            seed=i, phase_origin=t0, noise=0.01,
+        )
+        write_patch(p, os.path.join(directory, f"raw_{i:04d}.h5"))
+
+
+def _common_interior(a, b):
+    lo = max(a.coords["time"][0], b.coords["time"][0])
+    hi = min(a.coords["time"][-1], b.coords["time"][-1])
+    av = a.select(time=(lo, hi)).host_data()
+    bv = b.select(time=(lo, hi)).host_data()
+    assert av.shape == bv.shape and av.size > 0
+    return av, bv
+
+
+class TestCascadeStreamOps:
+    @pytest.mark.parametrize("fs,ratio", [(100.0, 100), (200.0, 40),
+                                          (50.0, 7)])
+    def test_stream_matches_batch_across_blocks(self, fs, ratio):
+        """Concatenated streamed outputs equal the one-shot causal
+        cascade after the warm-up, across uneven block boundaries."""
+        from tpudas.ops.fir import (
+            cascade_decimate,
+            cascade_decimate_stream,
+            cascade_stream_init,
+            design_cascade,
+            stream_warmup_outputs,
+        )
+
+        plan = design_cascade(fs, ratio, 0.45 * fs / ratio, 4)
+        warm = stream_warmup_outputs(plan)
+        rng = np.random.default_rng(0)
+        blocks = [
+            rng.standard_normal((n * ratio, 3)).astype(np.float32)
+            for n in (50, 13, 1, 27, 40)
+        ]
+        x = np.concatenate(blocks, axis=0)
+        carry = cascade_stream_init(plan, 3)
+        outs = []
+        for b in blocks:
+            y, carry = cascade_decimate_stream(b, carry, plan)
+            outs.append(np.asarray(y))
+        ys = np.concatenate(outs, axis=0)
+        n_cmp = ys.shape[0] - warm
+        assert n_cmp > 20  # the warm-up must not consume the test
+        ref = np.asarray(
+            cascade_decimate(x, plan, plan.delay, n_cmp, engine="xla")
+        )
+        err = np.abs(ys[warm:] - ref).max() / np.abs(ref).max()
+        assert err < 1e-5
+
+    def test_warmup_is_one_receptive_field_minus_one_output(self):
+        """The carry's mechanical lag telescopes to the receptive field
+        minus one output step (+ grid-alignment pad)."""
+        from tpudas.ops.fir import design_cascade, stream_warmup_outputs
+
+        plan = design_cascade(100.0, 100, 0.45, 4)
+        warm = stream_warmup_outputs(plan)
+        min_lag = plan.receptive_field - 1 - (plan.ratio - 1)
+        assert warm * plan.ratio >= min_lag
+        assert warm * plan.ratio < min_lag + plan.ratio
+
+    def test_block_and_carry_validation(self):
+        from tpudas.ops.fir import (
+            cascade_decimate_stream,
+            cascade_stream_init,
+            design_cascade,
+        )
+
+        plan = design_cascade(100.0, 10, 4.5, 4)
+        carry = cascade_stream_init(plan, 2)
+        with pytest.raises(ValueError, match="multiple of"):
+            cascade_decimate_stream(np.zeros((15, 2), np.float32), carry,
+                                    plan)
+        bad = tuple(b[:-1] for b in carry)
+        with pytest.raises(ValueError, match="carry"):
+            cascade_decimate_stream(np.zeros((20, 2), np.float32), bad,
+                                    plan)
+
+
+class TestFFTStreamOps:
+    def test_overlap_save_matches_batch(self):
+        from tpudas.ops.filter import (
+            fft_pass_filter,
+            fft_pass_filter_stream,
+            fft_stream_init,
+        )
+
+        rng = np.random.default_rng(1)
+        edge = 400
+        blocks = [
+            rng.standard_normal((n, 4)).astype(np.float32)
+            for n in (900, 512, 777, 1200)
+        ]
+        x = np.concatenate(blocks)
+        carry = fft_stream_init(edge, 4)
+        outs = []
+        for b in blocks:
+            y, carry = fft_pass_filter_stream(
+                b, carry, 0.01, high=5.0, order=4
+            )
+            outs.append(np.asarray(y))
+        ys = np.concatenate(outs)
+        # streamed position i lags the input by `edge`; skip the
+        # stream-start region in both (each has its own edge there)
+        ref = np.asarray(fft_pass_filter(x, 0.01, high=5.0, order=4))
+        a = ys[2 * edge:]
+        b = ref[edge : edge + a.shape[0]]
+        assert np.abs(a - b).max() / np.abs(b).max() < 1e-4
+
+
+class TestLFProcStream:
+    @pytest.fixture()
+    def source(self, tmp_path):
+        src = str(tmp_path / "src")
+        make_synthetic_spool(
+            src, n_files=5, file_duration=FILE_SEC, fs=FS, n_ch=NCH,
+            noise=0.01,
+        )
+        return src
+
+    def _batch(self, source, out, **params):
+        sp = spool(source).sort("time").update()
+        tmax = np.datetime64(sp.get_contents()["time_max"].max())
+        lfp = LFProc(sp)
+        lfp.update_processing_parameter(**params)
+        lfp.set_output_folder(out, delete_existing=True)
+        lfp.process_time_range(T0, tmax)
+        return spool(out).update().chunk(time=None)[0], tmax
+
+    @pytest.mark.parametrize(
+        "dt,tol,kind",
+        [
+            (1.0, 1e-4, "cascade"),  # ratio 100: sample-aligned grid
+            (1.1, 2e-3, "fft"),  # ratio 110 = 2*5*11: prime > 8
+        ],
+    )
+    def test_incremental_matches_batch_oracle(self, source, tmp_path, dt,
+                                              tol, kind):
+        params = dict(
+            output_sample_interval=dt,
+            process_patch_size=40,
+            edge_buff_size=8,
+        )
+        ref, tmax = self._batch(source, str(tmp_path / "batch"), **params)
+        sp = spool(source).sort("time").update()
+        lfp = LFProc(sp)
+        lfp.update_processing_parameter(**params)
+        out = str(tmp_path / "stream")
+        lfp.set_output_folder(out, delete_existing=True)
+        carry = lfp.open_stream(T0)
+        for t2 in (
+            T0 + np.timedelta64(50, "s"),
+            T0 + np.timedelta64(100, "s"),
+            tmax,
+        ):
+            lfp.process_stream_increment(carry, t2)
+        assert carry.kind == kind
+        merged = spool(out).update().chunk(time=None)
+        assert len(merged) == 1, "incremental output has seams"
+        av, bv = _common_interior(merged[0], ref)
+        assert np.abs(av - bv).max() / np.abs(bv).max() < tol
+
+    def test_serialized_resume_is_seam_free(self, source, tmp_path):
+        from tpudas.proc.stream import load_carry, save_carry
+
+        params = dict(
+            output_sample_interval=1.0,
+            process_patch_size=40,
+            edge_buff_size=8,
+        )
+        ref, tmax = self._batch(source, str(tmp_path / "batch"), **params)
+        out = str(tmp_path / "stream")
+        sp = spool(source).sort("time").update()
+        lfp = LFProc(sp)
+        lfp.update_processing_parameter(**params)
+        lfp.set_output_folder(out, delete_existing=True)
+        carry = lfp.open_stream(T0)
+        lfp.process_stream_increment(carry, T0 + np.timedelta64(80, "s"))
+        save_carry(carry, out)
+
+        # a fresh process: new LFProc, carry reloaded from disk
+        c2 = load_carry(out)
+        assert c2 is not None
+        assert c2.kind == carry.kind
+        assert c2.next_emit_ns == carry.next_emit_ns
+        assert c2.next_ingest_ns == carry.next_ingest_ns
+        for a, b in zip(c2.bufs, carry.bufs):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        lfp2 = LFProc(spool(source).sort("time").update())
+        lfp2.update_processing_parameter(**params)
+        lfp2.set_output_folder(out, delete_existing=False)
+        lfp2.process_stream_increment(c2, tmax)
+        merged = spool(out).update().chunk(time=None)
+        assert len(merged) == 1, "resumed output has a seam"
+        av, bv = _common_interior(merged[0], ref)
+        assert np.abs(av - bv).max() / np.abs(bv).max() < 1e-4
+
+
+class TestStatefulRealtime:
+    def _run(self, src, out, stateful, fed_state=None, counters=None,
+             events=None):
+        from tpudas.utils.logging import set_log_handler
+
+        state = fed_state if fed_state is not None else {"fed": 1}
+
+        def fake_sleep(_):
+            if state["fed"] < 1:
+                _append_files(src, 3, 2)
+                state["fed"] += 1
+
+        if events is not None:
+            set_log_handler(events.append)
+        try:
+            return run_lowpass_realtime(
+                source=src,
+                output_folder=out,
+                start_time=str(T0),
+                output_sample_interval=1.0,
+                edge_buffer=8.0,
+                process_patch_size=40,
+                poll_interval=0.0,
+                file_duration=0.0,
+                sleep_fn=fake_sleep,
+                counters=counters,
+                stateful=stateful,
+            )
+        finally:
+            if events is not None:
+                set_log_handler(None)
+
+    def test_stateful_matches_rewind_and_kills_redundancy(self, tmp_path):
+        from tpudas.utils.profiling import Counters
+
+        outs = {}
+        ctr = {}
+        for mode, flag in (("rewind", False), ("stateful", True)):
+            src = str(tmp_path / f"raw_{mode}")
+            make_synthetic_spool(
+                src, n_files=3, file_duration=FILE_SEC, fs=FS, n_ch=NCH,
+                noise=0.01,
+            )
+            out = str(tmp_path / mode)
+            ctr[mode] = Counters()
+            rounds = self._run(
+                src, out, flag, fed_state={"fed": 0}, counters=ctr[mode]
+            )
+            assert rounds == 2
+            merged = spool(out).update().chunk(time=None)
+            assert len(merged) == 1
+            outs[mode] = merged[0]
+        # the structural claim: rewind re-reads the edge buffer every
+        # resumed round, the carry reads nothing twice
+        assert ctr["rewind"].samples_redundant > 0
+        assert ctr["rewind"].redundant_ratio > 0.1
+        assert ctr["stateful"].samples_redundant == 0
+        assert ctr["stateful"].redundant_ratio == 0.0
+        av, bv = _common_interior(outs["stateful"], outs["rewind"])
+        assert np.abs(av - bv).max() / np.abs(bv).max() < 1e-4
+
+    def test_kill_and_resume_does_not_rewind(self, tmp_path):
+        """Two separate driver invocations (process kill/restart): the
+        second resumes from the serialized carry — no rewind, no
+        re-read — and the joined output is seam-free and matches the
+        one-shot batch oracle."""
+        from tpudas.utils.profiling import Counters
+
+        src = str(tmp_path / "raw")
+        out = str(tmp_path / "results")
+        make_synthetic_spool(
+            src, n_files=3, file_duration=FILE_SEC, fs=FS, n_ch=NCH,
+            noise=0.01,
+        )
+        # run 1 processes the initial 90 s, then the "process dies"
+        assert self._run(src, out, True) == 1
+        assert os.path.isfile(os.path.join(out, ".stream_carry.npz"))
+        n_files_run1 = len(
+            [f for f in os.listdir(out) if f.endswith(".h5")]
+        )
+        assert n_files_run1 > 0
+
+        # two more files arrive while it was down; run 2 resumes
+        _append_files(src, 3, 2)
+        events = []
+        ctr = Counters()
+        assert self._run(src, out, True, counters=ctr, events=events) >= 1
+        rt = [e for e in events if e["event"] == "realtime_round"]
+        assert all(e["mode"] == "stateful" for e in rt)
+        assert [e for e in events if e["event"] == "stream_resume"]
+        # no rewind: run 2 ingested only the NEW 60 s (ns-jitter slack)
+        assert ctr.data_seconds <= 61.0
+        assert ctr.samples_redundant == 0
+
+        merged = spool(out).update().chunk(time=None)
+        assert len(merged) == 1, "resumed stream has a seam"
+        steps = np.diff(merged[0].coords["time"].astype(np.int64))
+        assert np.all(steps == 1_000_000_000)
+
+        # oracle: one-shot batch run over the final stream
+        sp = spool(src).sort("time").update()
+        lfp = LFProc(sp)
+        lfp.update_processing_parameter(
+            output_sample_interval=1.0,
+            process_patch_size=40,
+            edge_buff_size=8,
+        )
+        lfp.set_output_folder(str(tmp_path / "batch"), delete_existing=True)
+        lfp.process_time_range(
+            T0, np.datetime64(sp.get_contents()["time_max"].max())
+        )
+        ref = spool(str(tmp_path / "batch")).update().chunk(time=None)[0]
+        av, bv = _common_interior(merged[0], ref)
+        assert np.abs(av - bv).max() / np.abs(bv).max() < 1e-4
+
+    def test_crash_between_write_and_save_reconciles(self, tmp_path):
+        """Output files newer than the carry (crash after the round's
+        writes, before its carry save) are deleted on resume and
+        regenerated identically — the crash-only contract on O(1)
+        state."""
+        src = str(tmp_path / "raw")
+        out = str(tmp_path / "results")
+        make_synthetic_spool(
+            src, n_files=3, file_duration=FILE_SEC, fs=FS, n_ch=NCH,
+            noise=0.01,
+        )
+        assert self._run(src, out, True) == 1
+        from tpudas.proc.stream import load_carry
+
+        carry = load_carry(out)
+        # simulate the crashed round's partial emission: a file past
+        # the carry's recorded head
+        stray_t0 = np.datetime64(int(carry.last_emit_ns), "ns") + \
+            np.timedelta64(3600, "s")
+        stray = synthetic_patch(
+            t0=stray_t0, duration=5.0, fs=1.0, n_ch=NCH, seed=9
+        )
+        stray_name = "LFDAS_2023-03-23T000000.0_2023-03-23T000005.0.h5"
+        write_patch(stray, os.path.join(out, stray_name))
+        _append_files(src, 3, 2)
+        events = []
+        assert self._run(src, out, True, events=events) >= 1
+        assert not os.path.exists(os.path.join(out, stray_name))
+        assert [
+            e for e in events if e["event"] == "stream_reconcile_removed"
+        ]
+        merged = spool(out).update().chunk(time=None)
+        assert len(merged) == 1
+
+    def test_resume_with_changed_config_is_rejected(self, tmp_path):
+        """A persisted carry continues ITS grid — restarting with a
+        moved start_time (or another engine) must raise instead of
+        silently ignoring the new setting."""
+        src = str(tmp_path / "raw")
+        out = str(tmp_path / "results")
+        make_synthetic_spool(
+            src, n_files=3, file_duration=FILE_SEC, fs=FS, n_ch=NCH,
+            noise=0.01,
+        )
+        assert self._run(src, out, True) == 1
+        _append_files(src, 3, 1)
+        with pytest.raises(ValueError, match="different start_time"):
+            run_lowpass_realtime(
+                source=src,
+                output_folder=out,
+                start_time=str(T0 + np.timedelta64(30, "s")),
+                output_sample_interval=1.0,
+                edge_buffer=8.0,
+                process_patch_size=40,
+                poll_interval=0.0,
+                sleep_fn=lambda _: None,
+                stateful=True,
+            )
+
+    def test_rewind_write_invalidates_stale_carry(self, tmp_path):
+        """A rewind-mode round over a stateful folder removes the
+        persisted carry (a later stateful resume must not reconcile
+        valid rewind-written outputs away against stale state) and
+        CONTINUES from the folder head — no stateful-era product is
+        deleted or rewritten, and the joined stream stays seam-free."""
+        src = str(tmp_path / "raw")
+        out = str(tmp_path / "results")
+        make_synthetic_spool(
+            src, n_files=3, file_duration=FILE_SEC, fs=FS, n_ch=NCH,
+            noise=0.01,
+        )
+        assert self._run(src, out, True) == 1
+        assert os.path.isfile(os.path.join(out, ".stream_carry.npz"))
+        stateful_files = {
+            f for f in os.listdir(out) if f.endswith(".h5")
+        }
+        # new data processed by a rewind-mode run (e.g. the operator
+        # flipped TPUDAS_STREAM_STATEFUL=0): the carry must go, the
+        # stateful-era outputs must all survive
+        _append_files(src, 3, 1)
+        assert self._run(src, out, False) == 1
+        assert not os.path.isfile(os.path.join(out, ".stream_carry.npz"))
+        files_after_rewind = {
+            f for f in os.listdir(out) if f.endswith(".h5")
+        }
+        assert stateful_files <= files_after_rewind
+        assert len(spool(out).update().chunk(time=None)) == 1
+        # back to stateful: legacy fallback, and still no deletions
+        _append_files(src, 4, 1)
+        assert self._run(src, out, True) == 1
+        remaining = {f for f in os.listdir(out) if f.endswith(".h5")}
+        assert files_after_rewind <= remaining
+        merged = spool(out).update().chunk(time=None)
+        assert len(merged) == 1
+
+    def test_legacy_folder_without_carry_falls_back_to_rewind(
+        self, tmp_path
+    ):
+        src = str(tmp_path / "raw")
+        out = str(tmp_path / "results")
+        make_synthetic_spool(
+            src, n_files=3, file_duration=FILE_SEC, fs=FS, n_ch=NCH,
+            noise=0.01,
+        )
+        # a rewind-mode run leaves outputs but no carry
+        assert self._run(src, out, False) == 1
+        assert not os.path.exists(os.path.join(out, ".stream_carry.npz"))
+        _append_files(src, 3, 2)
+        events = []
+        assert self._run(src, out, True, events=events) >= 1
+        rt = [e for e in events if e["event"] == "realtime_round"]
+        assert rt and all(e["mode"] == "rewind" for e in rt)
+        assert [e for e in events if e["event"] == "stream_legacy_rewind"]
+        merged = spool(out).update().chunk(time=None)
+        assert len(merged) == 1  # the rewind resume is still seam-free
+
+    def test_env_flag_restores_rewind(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPUDAS_STREAM_STATEFUL", "0")
+        src = str(tmp_path / "raw")
+        out = str(tmp_path / "results")
+        make_synthetic_spool(
+            src, n_files=3, file_duration=FILE_SEC, fs=FS, n_ch=NCH,
+            noise=0.01,
+        )
+        events = []
+        assert self._run(src, out, None, events=events) == 1
+        rt = [e for e in events if e["event"] == "realtime_round"]
+        assert rt and all(e["mode"] == "rewind" for e in rt)
+        assert not os.path.exists(os.path.join(out, ".stream_carry.npz"))
+
+
+class TestStreamBench:
+    def test_bench_reports_the_structural_win(self, tmp_path):
+        """The PR's acceptance bench: >= 1.5x fewer full-rate samples
+        per steady-state round, matching outputs, zero redundancy in
+        stateful mode."""
+        import tools.stream_bench as sb
+
+        out = str(tmp_path / "BENCH_stream.json")
+        report = sb.run(out, rounds=3, files_per_round=2)
+        assert os.path.isfile(out)
+        with open(out) as fh:
+            on_disk = json.load(fh)
+        assert on_disk["samples_ratio"] == report["samples_ratio"]
+        assert report["samples_ratio"] >= 1.5
+        assert report["outputs_match"]
+        assert report["redundant_ratio_stateful"] == 0.0
+        assert report["redundant_ratio_rewind"] > 0.2
+        assert report["config"]["edge_over_window"] >= 0.5

@@ -1,0 +1,307 @@
+"""Steady-state streaming bench (CPU): stateful carry vs edge-buffer
+rewind.
+
+Drives ``run_lowpass_realtime`` twice over the same growing synthetic
+spool — once in the classic rewind mode, once with the carried filter
+state — and reports the structural win the stateful mode claims:
+
+- ``samples_ratio``: full-rate samples processed per steady-state
+  round, rewind / stateful (>= 1.5 at the representative config below,
+  where the edge buffer is >= 0.5x the per-round data window);
+- ``redundant_ratio_rewind``: fraction of rewind-mode samples that
+  were re-reads (tpudas.utils.profiling.Counters.redundant_ratio);
+- ``rounds_per_sec`` and mean per-round wall latency for both modes;
+- ``first_output_latency_s``: wall time from driver start to the first
+  output file landing on disk;
+- ``head_lag_s``: stream-seconds between the newest input sample and
+  the newest emitted output at the end of the run (how far behind live
+  each mode's product sits);
+- ``outputs_match``: max relative difference between the two modes'
+  outputs over their common interior (the rewind mode is the oracle).
+
+Writes one JSON artifact (default ``BENCH_pr01.json`` at the repo
+root) and prints it.  Pure CPU — no TPU tunnel, no subprocess dance —
+so CI can run it anywhere:
+
+    JAX_PLATFORMS=cpu python tools/stream_bench.py [--out PATH]
+        [--rounds N] [--files-per-round K]
+
+Also reachable as ``BENCH_MODE=stream python bench.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+# the representative geometry: per-round window = FILES_PER_ROUND *
+# FILE_SEC seconds of new data; EDGE_SEC >= 0.5x that window, so the
+# rewind re-reads >= ~half a window of full-rate data every round
+FS = 100.0
+FILE_SEC = 30.0
+N_CH = 16
+DT_OUT = 1.0
+EDGE_SEC = 40.0
+PATCH_OUT = 100
+
+
+def _drive(src, out, rounds, files_per_round, stateful, feed):
+    """One realtime run: ``feed(round_index)`` appends that round's
+    files before each poll.  Returns the per-round metrics."""
+    from tpudas.proc.streaming import run_lowpass_realtime
+    from tpudas.utils.logging import set_log_handler
+    from tpudas.utils.profiling import Counters
+
+    events = []
+    set_log_handler(events.append)
+    counters = Counters()
+    state = {"fed": 0, "first_out": None, "t0": time.perf_counter()}
+
+    def fake_sleep(_):
+        if state["first_out"] is None and any(
+            f.endswith(".h5") for f in os.listdir(out)
+        ):
+            state["first_out"] = time.perf_counter() - state["t0"]
+        if state["fed"] < rounds - 1:
+            state["fed"] += 1
+            feed(state["fed"])
+
+    try:
+        n_rounds = run_lowpass_realtime(
+            source=src,
+            output_folder=out,
+            start_time="2023-03-22T00:00:00",
+            output_sample_interval=DT_OUT,
+            edge_buffer=EDGE_SEC,
+            process_patch_size=PATCH_OUT,
+            poll_interval=0.0,
+            file_duration=0.0,
+            sleep_fn=fake_sleep,
+            max_rounds=rounds + 2,
+            counters=counters,
+            stateful=stateful,
+        )
+    finally:
+        set_log_handler(None)
+    if state["first_out"] is None and any(
+        f.endswith(".h5") for f in os.listdir(out)
+    ):
+        state["first_out"] = time.perf_counter() - state["t0"]
+    per_round = [
+        e for e in events if e["event"] == "realtime_round"
+    ]
+    return {
+        "rounds": n_rounds,
+        "mode": per_round[-1]["mode"] if per_round else None,
+        "data_seconds": [e["data_seconds"] for e in per_round],
+        "wall_seconds": [e["wall_seconds"] for e in per_round],
+        "counters": {
+            "channel_samples": counters.channel_samples,
+            "samples_redundant": counters.samples_redundant,
+            "redundant_ratio": round(counters.redundant_ratio, 4),
+            "realtime_factor": round(counters.realtime_factor, 2),
+        },
+        "first_output_latency_s": (
+            None
+            if state["first_out"] is None
+            else round(state["first_out"], 3)
+        ),
+    }
+
+
+def _merged(out):
+    from tpudas.io.spool import spool
+
+    merged = spool(out).update().chunk(time=None)
+    assert len(merged) == 1, f"output of {out} has seams"
+    return merged[0]
+
+
+def run(out_path, rounds=4, files_per_round=2):
+    import tempfile
+
+    from tpudas.testing import make_synthetic_spool
+
+    t_bench0 = time.perf_counter()
+    results = {}
+    # the rewind mode's window schedule needs its first grid to exceed
+    # patch > 2*edge points, so the initial backlog must cover more
+    # than PATCH_OUT output steps; steady-state rounds then add
+    # files_per_round * FILE_SEC each
+    n_init = max(
+        files_per_round, int(np.ceil((PATCH_OUT + 20) * DT_OUT / FILE_SEC))
+    )
+    with tempfile.TemporaryDirectory() as td:
+        srcs = {}
+        for mode in ("rewind", "stateful"):
+            src = os.path.join(td, f"src_{mode}")
+            make_synthetic_spool(
+                src,
+                n_files=n_init,
+                file_duration=FILE_SEC,
+                fs=FS,
+                n_ch=N_CH,
+                noise=0.01,
+            )
+            srcs[mode] = src
+
+        def feeder(mode):
+            def feed(r):
+                make_synthetic_spool(
+                    srcs[mode],
+                    n_files=files_per_round,
+                    file_duration=FILE_SEC,
+                    fs=FS,
+                    n_ch=N_CH,
+                    noise=0.01,
+                    start=np.datetime64("2023-03-22T00:00:00")
+                    + np.timedelta64(
+                        int(
+                            (n_init + (r - 1) * files_per_round)
+                            * FILE_SEC
+                            * 1e9
+                        ),
+                        "ns",
+                    ),
+                    prefix=f"raw{r}",
+                )
+
+            return feed
+
+        outs = {}
+        for mode, stateful in (("rewind", False), ("stateful", True)):
+            out = os.path.join(td, f"out_{mode}")
+            t0 = time.perf_counter()
+            results[mode] = _drive(
+                srcs[mode], out, rounds, files_per_round, stateful,
+                feeder(mode),
+            )
+            results[mode]["total_wall_s"] = round(
+                time.perf_counter() - t0, 3
+            )
+            outs[mode] = out
+            # head lag: newest input vs newest output
+            from tpudas.io.spool import spool
+
+            t_in = np.datetime64(
+                spool(srcs[mode]).update().get_contents()["time_max"].max()
+            ).astype("datetime64[ns]")
+            p = _merged(out)
+            t_out = np.datetime64(
+                p.coords["time"][-1], "ns"
+            )
+            results[mode]["head_lag_s"] = round(
+                float((t_in - t_out) / np.timedelta64(1, "s")), 3
+            )
+            results[mode]["output_rows"] = int(p.shape[0])
+
+        # cross-mode numeric agreement over the common interior
+        a = _merged(outs["stateful"])
+        b = _merged(outs["rewind"])
+        lo = max(a.coords["time"][0], b.coords["time"][0])
+        hi = min(a.coords["time"][-1], b.coords["time"][-1])
+        av = a.select(time=(lo, hi)).host_data()
+        bv = b.select(time=(lo, hi)).host_data()
+        rel = float(np.abs(av - bv).max() / np.abs(bv).max())
+
+    # steady-state per-round workload: skip round 1 (both modes chew
+    # the identical initial backlog there)
+    def steady(d):
+        ds = d["data_seconds"][1:]
+        return sum(ds) / len(ds) if ds else 0.0
+
+    sr, ss = steady(results["rewind"]), steady(results["stateful"])
+    per_round_wall = {
+        m: (
+            sum(results[m]["wall_seconds"]) / len(results[m]["wall_seconds"])
+            if results[m]["wall_seconds"]
+            else 0.0
+        )
+        for m in results
+    }
+    report = {
+        "metric": "stream_redundancy",
+        "config": {
+            "fs": FS,
+            "n_ch": N_CH,
+            "dt_out": DT_OUT,
+            "edge_sec": EDGE_SEC,
+            "file_sec": FILE_SEC,
+            "files_per_round": files_per_round,
+            "rounds": rounds,
+            "edge_over_window": round(
+                EDGE_SEC / (files_per_round * FILE_SEC), 3
+            ),
+        },
+        # the acceptance number: full-rate samples per steady round,
+        # rewind / stateful (>= 1.5 means the carry eliminated at
+        # least a third of the rewind mode's per-round work)
+        "samples_ratio": round(sr / ss, 3) if ss else None,
+        "steady_round_data_seconds": {
+            "rewind": round(sr, 3),
+            "stateful": round(ss, 3),
+        },
+        "redundant_ratio_rewind": results["rewind"]["counters"][
+            "redundant_ratio"
+        ],
+        "redundant_ratio_stateful": results["stateful"]["counters"][
+            "redundant_ratio"
+        ],
+        "rounds_per_sec": {
+            m: (
+                round(results[m]["rounds"] / results[m]["total_wall_s"], 3)
+                if results[m]["total_wall_s"]
+                else None
+            )
+            for m in results
+        },
+        "round_latency_s": {
+            m: round(per_round_wall[m], 4) for m in per_round_wall
+        },
+        "first_output_latency_s": {
+            m: results[m]["first_output_latency_s"] for m in results
+        },
+        "head_lag_s": {m: results[m]["head_lag_s"] for m in results},
+        "outputs_match_rel_err": round(rel, 8),
+        "outputs_match": rel < 1e-4,
+        "modes": results,
+        "bench_wall_s": round(time.perf_counter() - t_bench0, 2),
+    }
+    with open(out_path, "w") as fh:
+        json.dump(report, fh, indent=1)
+        fh.write("\n")
+    print(json.dumps(report))
+    return report
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--out", default=os.path.join(REPO, "BENCH_pr01.json")
+    )
+    ap.add_argument("--rounds", type=int, default=4)
+    ap.add_argument("--files-per-round", type=int, default=2)
+    args = ap.parse_args()
+    report = run(
+        args.out, rounds=args.rounds, files_per_round=args.files_per_round
+    )
+    # loud, parseable verdict for CI
+    ok = (
+        report["outputs_match"]
+        and (report["samples_ratio"] or 0) >= 1.5
+        and report["redundant_ratio_stateful"] == 0.0
+    )
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

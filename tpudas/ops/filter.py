@@ -28,7 +28,13 @@ from tpudas.ops.fftlen import next_tpu_fft_len
 
 from tpudas.core import units as _units
 
-__all__ = ["patch_pass_filter", "fft_lowpass_response", "fft_pass_filter"]
+__all__ = [
+    "patch_pass_filter",
+    "fft_lowpass_response",
+    "fft_pass_filter",
+    "fft_stream_init",
+    "fft_pass_filter_stream",
+]
 
 
 def _butter_mag2(freqs, low, high, order):
@@ -94,6 +100,59 @@ def fft_lowpass_response(nfft, d_sec, corner, order=4):
     fused pipelines, e.g. tpudas.parallel.pipeline)."""
     freqs = jnp.arange(nfft // 2 + 1, dtype=jnp.float32) / (nfft * d_sec)
     return _butter_mag2(freqs, None, jnp.float32(corner), order)
+
+
+# ---------------------------------------------------------------------------
+# streaming overlap-save: carry the filter's edge support across blocks
+#
+# The batch entry point above re-filters a window that includes the
+# edge support on both sides; a streaming caller would have to re-read
+# that halo every block.  The carry below is the overlap-save state —
+# the last ``2 * edge`` RAW input samples — so each input sample enters
+# the FFT engine exactly once and the emitted region of every block is
+# clean (full ``edge`` support on both sides, circular-wrap artifacts
+# confined to the discarded halo) as long as ``edge`` covers the
+# filter's impulse-response support at the engine's tolerance (the
+# same contract the batch overlap-save scheduler enforces through
+# tpudas.proc.edge).
+
+
+def fft_stream_init(edge: int, n_ch: int) -> np.ndarray:
+    """Zero carry for :func:`fft_pass_filter_stream`: the stream's last
+    ``2 * edge`` input samples (zeros = silence before the stream)."""
+    return np.zeros((2 * int(edge), int(n_ch)), np.float32)
+
+
+def fft_pass_filter_stream(block, carry, d_sec, low=None, high=None,
+                           order=4):
+    """One streaming step of the zero-phase FFT band filter.
+
+    block: (T, C) new input samples; carry: (2*edge, C) from
+    :func:`fft_stream_init` or a previous step.  Returns
+    ``(filtered, new_carry)`` where ``filtered[i]`` is the zero-phase
+    filtered value of the stream at the position ``edge`` samples
+    BEHIND ``block[i]`` — the emission lags the input by ``edge``
+    samples (an output needs its right-side support before it can be
+    clean).  With a zero-initialized carry the first ``edge`` emitted
+    samples read pre-stream silence; callers discard them exactly as
+    the batch path discards its stream-start edge.
+    """
+    carry = jnp.asarray(carry, jnp.float32)
+    block = jnp.asarray(block, jnp.float32)
+    if carry.ndim != 2 or carry.shape[0] % 2:
+        raise ValueError(
+            f"carry must be (2*edge, C), got {tuple(carry.shape)}"
+        )
+    if block.ndim != 2 or block.shape[1] != carry.shape[1]:
+        raise ValueError(
+            f"block {tuple(block.shape)} does not match carry "
+            f"{tuple(carry.shape)}"
+        )
+    edge = carry.shape[0] // 2
+    xc = jnp.concatenate([carry, block], axis=0)
+    filt = fft_pass_filter(xc, d_sec, low=low, high=high, order=order)
+    out = filt[edge : edge + block.shape[0]]
+    return out, xc[xc.shape[0] - 2 * edge :]
 
 
 def _host_sosfiltfilt(data, d_sec, low, high, order):

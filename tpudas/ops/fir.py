@@ -51,6 +51,11 @@ __all__ = [
     "factor_ratio",
     "design_cascade",
     "cascade_decimate",
+    "cascade_decimate_stream",
+    "cascade_stream_init",
+    "stream_carry_sizes",
+    "stream_warmup_outputs",
+    "stream_stage_engines",
     "impulse_response",
     "edge_support_samples",
     "butter2_mag",
@@ -538,8 +543,9 @@ def _build_cascade_fn(plan: CascadePlan, n_out: int, engine: str, mesh=None,
             )
 
     if mesh is not None:
-        from jax import shard_map
         from jax.sharding import PartitionSpec as P
+
+        from tpudas.parallel.compat import shard_map
 
         spec = P(None, ch_axis)
         in_specs = (spec, P()) if quantized else (spec,)
@@ -601,6 +607,185 @@ def cascade_decimate(
                            quantized=quantized)
     out = fn(*args)
     return out[:, :C] if pad_c else out
+
+
+# ---------------------------------------------------------------------------
+# stateful streaming: carry per-stage filter state across blocks
+#
+# The batch entry points above re-derive every output from a window
+# that includes the filter's full edge support — a caller processing a
+# live stream must therefore re-read ~2x the edge of FULL-RATE data
+# per round just to rebuild transient state it already computed.  The
+# streaming form below instead carries each stage's trailing input
+# samples as an explicit O(1) pytree: every input sample flows through
+# every stage exactly once.
+#
+# Semantics (the contract tests/test_stream_state.py pins): feed the
+# stream in blocks whose length is a multiple of ``plan.ratio``.  With
+# ``X`` the concatenation of everything fed so far, the concatenated
+# outputs satisfy
+#
+#     y_stream[m] == cascade_decimate(X, plan, phase=plan.delay, .)[m - W]
+#
+# for m >= W := stream_warmup_outputs(plan) — i.e. after the warm-up
+# (the first W outputs read the zero-initialized carry and are
+# discarded by callers), streamed output m is the zero-phase filtered
+# value of the stream at full-rate index (m - W) * ratio + delay, and
+# every kept output reads only samples that have already arrived (the
+# emission lag past an output's center is exactly the filter's causal
+# support, delay full-rate samples).
+#
+# Per-stage carry: stage i keeps its last P_i input samples, with
+# P_i >= len(taps_i) - R_i so each new block's outputs have their full
+# look-back.  The composite full-rate lag D = sum_i P_i * prod_{j<i}
+# R_j telescopes to receptive_field - ratio at the minimal sizes;
+# stage 0's carry absorbs the padding that rounds D up to a multiple
+# of ratio so the streamed grid stays on the decimated grid
+# (W = D / ratio).
+
+
+@functools.lru_cache(maxsize=256)
+def stream_carry_sizes(plan: CascadePlan) -> tuple:
+    """Per-stage carried trailing samples (at each stage's own input
+    rate).  Stage 0 includes the alignment pad that makes the composite
+    lag a whole number of output samples."""
+    sizes = [max(len(h) - int(R), 0) for R, h in plan.stages]
+    d = 0
+    prod = 1
+    for p, (R, _h) in zip(sizes, plan.stages):
+        d += p * prod
+        prod *= int(R)
+    sizes[0] += (-d) % plan.ratio
+    return tuple(sizes)
+
+
+def stream_warmup_outputs(plan: CascadePlan) -> int:
+    """Outputs to discard after a zero-initialized carry (the composite
+    stream lag in output samples)."""
+    d = 0
+    prod = 1
+    for p, (R, _h) in zip(stream_carry_sizes(plan), plan.stages):
+        d += p * prod
+        prod *= int(R)
+    assert d % plan.ratio == 0
+    return d // plan.ratio
+
+
+def cascade_stream_init(plan: CascadePlan, n_ch: int) -> tuple:
+    """Zero carry pytree for :func:`cascade_decimate_stream`."""
+    return tuple(
+        np.zeros((p, int(n_ch)), np.float32)
+        for p in stream_carry_sizes(plan)
+    )
+
+
+def _stream_stage_pallas(plan: CascadePlan, T: int, n_ch: int,
+                         engine: str) -> tuple:
+    """Static per-stage engine decisions for a stream block of T
+    full-rate rows (True = the Pallas kernel runs that stage).
+
+    Gated on ``TPUDAS_STREAM_PALLAS=1`` (off by default, read at
+    build time): a stream block's carry-extended input is never the
+    kernel's exact ``stage_input_rows`` sizing, so every Pallas stage
+    would pay the internal pad's full input copy per block — whether
+    that still beats the XLA formulation at stream block sizes is a
+    measure-on-silicon question, and until it is measured the stream
+    step stays on the proven path.  The batch entry points are
+    unaffected."""
+    import os
+
+    if os.environ.get("TPUDAS_STREAM_PALLAS", "0") != "1":
+        return tuple(False for _ in plan.stages)
+    use = []
+    t = int(T)
+    for R, h in plan.stages:
+        k = t // int(R)
+        b = -(-len(h) // int(R))
+        use.append(
+            engine == "pallas" and _pallas_stage_ok(k, int(R), n_ch, b)
+        )
+        t = k
+    return tuple(use)
+
+
+def stream_stage_engines(plan: CascadePlan, T: int, n_ch: int,
+                         engine: str = "auto") -> list:
+    """Ground truth of which engine each stage runs under for a stream
+    block of ``T`` rows — the streaming analogue of
+    :func:`stage_engines` (same observability contract)."""
+    engine = resolve_cascade_engine(engine)
+    return [
+        "pallas" if u else "xla"
+        for u in _stream_stage_pallas(plan, T, n_ch, engine)
+    ]
+
+
+@functools.lru_cache(maxsize=128)
+def _build_stream_cascade_fn(plan: CascadePlan, T: int, n_ch: int,
+                             engine: str):
+    """jit-compiled stateful step: (x (T, C), carry) -> (y (T/ratio, C),
+    new_carry).  The carry is donated on accelerator backends — the
+    buffers are dead the moment the step returns, so steady-state
+    streaming allocates nothing per round."""
+    import jax
+    import jax.numpy as jnp
+
+    blocked = _blocked_taps(plan)
+    sizes = stream_carry_sizes(plan)
+    use_pallas = _stream_stage_pallas(plan, T, n_ch, engine)
+    interpret = _pallas_interpret() if any(use_pallas) else False
+
+    def fn(x, carry):
+        x = x.astype(jnp.float32)
+        new_carry = []
+        for (R, hb), p, pall, buf in zip(blocked, sizes, use_pallas, carry):
+            xc = jnp.concatenate([buf, x], axis=0) if p else x
+            k = x.shape[0] // R
+            if pall:
+                from tpudas.ops.pallas_fir import fir_decimate_pallas
+
+                y = fir_decimate_pallas(
+                    xc, hb, R, n_out=k, interpret=interpret
+                )
+            else:
+                y = _polyphase_stage_xla(xc, hb, R, k)
+            new_carry.append(xc[xc.shape[0] - p:])
+            x = y
+        return x, tuple(new_carry)
+
+    donate = (1,) if jax.default_backend() not in ("cpu",) else ()
+    return jax.jit(fn, donate_argnums=donate)
+
+
+def cascade_decimate_stream(x, carry, plan: CascadePlan, engine="auto"):
+    """One stateful streaming step of the cascade.
+
+    x: (T, C) float32 block, T a multiple of ``plan.ratio``; ``carry``
+    from :func:`cascade_stream_init` or a previous step.  Returns
+    ``(y (T/ratio, C), new_carry)`` — see the streamed-output contract
+    in the section comment above.  The previous carry must not be
+    reused after the call (its buffers are donated on accelerators).
+    """
+    import jax.numpy as jnp
+
+    engine = resolve_cascade_engine(engine)
+    x = jnp.asarray(x)
+    T = int(x.shape[0])
+    if T % plan.ratio:
+        raise ValueError(
+            f"stream block length {T} is not a multiple of the "
+            f"decimation ratio {plan.ratio}"
+        )
+    sizes = stream_carry_sizes(plan)
+    if len(carry) != len(sizes) or any(
+        int(np.shape(b)[0]) != p for b, p in zip(carry, sizes)
+    ):
+        raise ValueError(
+            "carry does not match this plan's stream_carry_sizes "
+            f"({[int(np.shape(b)[0]) for b in carry]} vs {list(sizes)})"
+        )
+    fn = _build_stream_cascade_fn(plan, T, int(x.shape[1]), engine)
+    return fn(x, tuple(jnp.asarray(b, jnp.float32) for b in carry))
 
 
 # ---------------------------------------------------------------------------

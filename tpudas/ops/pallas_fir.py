@@ -127,7 +127,12 @@ def _mosaic_knobs():
         cp_kwargs["vmem_limit_bytes"] = vmem_mb * 2**20
     call_kwargs = {}
     if cp_kwargs:
-        call_kwargs["compiler_params"] = pltpu.CompilerParams(**cp_kwargs)
+        # renamed TPUCompilerParams -> CompilerParams across jax
+        # versions; accept either spelling
+        params_cls = getattr(pltpu, "CompilerParams", None) or getattr(
+            pltpu, "TPUCompilerParams"
+        )
+        call_kwargs["compiler_params"] = params_cls(**cp_kwargs)
     return grid_order, call_kwargs
 
 

@@ -39,7 +39,7 @@ def batched_rolling_mean(mesh, batch, w: int, s: int, batch_axis="ch"):
 def _build_batched_cascade_fn(
     plan, n_out, engine, mesh, batch_axis, ch_axis, quantized
 ):
-    from jax import shard_map
+    from tpudas.parallel.compat import shard_map
 
     from tpudas.ops.fir import (
         _apply_cascade_stages,

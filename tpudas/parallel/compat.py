@@ -1,0 +1,35 @@
+"""JAX version-compat shims for the parallel layer.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` to the
+top-level ``jax`` namespace, and its replication-check keyword was
+renamed ``check_rep`` -> ``check_vma`` in the same window.  Every
+shard_map call site in tpudas goes through this wrapper so the codebase
+runs unmodified on either side of the migration.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.6-era top-level export
+    from jax import shard_map as _shard_map
+except ImportError:  # the long-lived experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = inspect.signature(_shard_map).parameters
+
+__all__ = ["shard_map"]
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """``jax.shard_map`` with the keyword spelling of whichever JAX is
+    installed (``check_vma`` here maps onto ``check_rep`` on older
+    versions — same semantics, renamed upstream)."""
+    kwargs = {}
+    if "check_vma" in _PARAMS:
+        kwargs["check_vma"] = check_vma
+    elif "check_rep" in _PARAMS:
+        kwargs["check_rep"] = check_vma
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )

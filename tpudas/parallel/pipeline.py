@@ -22,7 +22,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+from tpudas.parallel.compat import shard_map
 
 from tpudas.ops.fftlen import next_tpu_fft_len
 from tpudas.ops.filter import fft_lowpass_response

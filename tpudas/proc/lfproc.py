@@ -101,6 +101,15 @@ def check_merge(plist):
     return plist[0]
 
 
+def output_corner(dt_out: float) -> float:
+    """The engine's per-window filter corner: 0.9x the post-decimation
+    Nyquist (reference lf_das.py:223).  Single definition shared by the
+    batch path and the stateful stream path (tpudas.proc.stream) — the
+    two must stay numerically identical or stateful output diverges
+    from the batch oracle."""
+    return 1.0 / float(dt_out) / 2.0 * 0.9
+
+
 def schedule_windows(n_grid: int, patch_size: int, buff_size: int):
     """The overlap-save schedule over a time grid of ``n_grid`` points.
 
@@ -367,6 +376,31 @@ class LFProc:
         out_sp = make_spool(self._output_folder).sort("time").update()
         return out_sp[-1].attrs["time_max"]
 
+    # stateful streaming ----------------------------------------------
+    def open_stream(self, start_time):
+        """A fresh :class:`tpudas.proc.stream.StreamCarry` for this
+        engine's parameters, anchored at ``start_time`` — the resumable
+        alternative to the window path: instead of padding + trimming
+        edges every call, the carry holds each filter stage's O(1)
+        trailing state and :meth:`process_stream_increment` extends the
+        output without re-reading anything."""
+        from tpudas.proc.stream import open_stream
+
+        return open_stream(self, start_time)
+
+    def process_stream_increment(self, carry, edtime):
+        """Process all NEW data up to ``edtime`` through the carried
+        filter state (cascade per-stage carry or FFT overlap-save
+        carry), writing output files and advancing ``carry`` in place.
+        Returns the number of output samples emitted.  Numerically
+        matches :meth:`process_time_range` over the same span (the
+        batch path is the oracle; see tests/test_stream_state.py)."""
+        if self._output_folder is None:
+            raise Exception("Please setup output folder first")
+        from tpudas.proc.stream import process_increment
+
+        return process_increment(self, carry, edtime)
+
     # the engine -------------------------------------------------------
     def _load_window(self, t_lo, t_hi, on_gap):
         """Host side: read + merge one window from the source spool.
@@ -556,7 +590,7 @@ class LFProc:
             log_event("segment_too_short", grid_points=len(time_grid))
             return 0
         windows = schedule_windows(len(time_grid), patch_size, buff_size)
-        corner = 1.0 / dt / 2.0 * 0.9  # 0.9x post-decimation Nyquist
+        corner = output_corner(dt)
 
         if (
             self._para.get("window_dp")

@@ -1,0 +1,705 @@
+"""Stateful streaming execution for LFProc: carry filter state across
+polling rounds instead of rewinding the edge buffer.
+
+The classic crash-only resume (tpudas.proc.streaming) rewinds
+``t1 = t_last - (ceil(edge/dt) - 1) * dt`` every round, so every round
+re-reads and re-filters ~2x the filter's edge support of FULL-RATE
+data just to rebuild transient state the previous round already
+computed.  This module carries that state explicitly — O(1) per filter
+stage — so each input sample is read and filtered exactly once:
+
+- cascade engine: the per-stage trailing-sample carry of
+  :func:`tpudas.ops.fir.cascade_decimate_stream`;
+- FFT engine: the overlap-save carry of
+  :func:`tpudas.ops.filter.fft_pass_filter_stream` plus the last
+  filtered row (lerp continuity across block seams).
+
+Crash-only property preserved: the carry serializes to ONE ``.npz``
+beside the output files (meta embedded as JSON for atomicity, written
+tmp-then-rename, plus a human-readable ``.json`` sidecar).  The save
+happens AFTER the round's output writes, so on a crash the carry is
+never ahead of the outputs; :func:`reconcile_outputs` deletes output
+files newer than the carry on resume (the crashed round's partial
+emission — regenerated identically, filenames are deterministic).  A
+folder with outputs but no carry is a legacy rewind-mode folder; the
+driver falls back to rewind for it.
+
+Emission alignment (shared by both engines): the output grid is
+``start + k * step`` (ms-quantized, the batch contract).  A cold
+stream anchors at the first grid point covered by data and discards
+the first ``edge_buff_size`` outputs — exactly the stream-start edge
+the batch scheduler discards — plus, for the cascade, the carry's
+mechanical warm-up (:func:`tpudas.ops.fir.stream_warmup_outputs`).
+After that, every emitted output has its full filter support and the
+stream head lags live data by only the filter's causal support, not a
+window schedule.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from tpudas.core.timeutils import to_datetime64
+from tpudas.utils.logging import log_event
+
+__all__ = [
+    "StreamCarry",
+    "CARRY_FILENAME",
+    "save_carry",
+    "load_carry",
+    "discard_carry",
+    "reconcile_outputs",
+    "open_stream",
+    "process_increment",
+]
+
+CARRY_FILENAME = ".stream_carry.npz"
+CARRY_SIDECAR = ".stream_carry.json"
+_VERSION = 1
+
+
+@dataclass
+class StreamCarry:
+    """The O(1) resume state of a stateful stream.
+
+    Configuration fields are fixed at :func:`open_stream`; engine
+    fields stay ``None`` until the first data arrives (``kind`` is the
+    open marker).  ``bufs`` holds jax or numpy arrays interchangeably
+    (serialization converts to numpy).
+    """
+
+    # configuration (validated against the driver's parameters on resume)
+    start_ns: int  # output-grid anchor (the run's start_time)
+    step_ns: int  # ms-quantized output grid step
+    dt_out: float  # output_sample_interval seconds
+    buff_out: int  # edge_buff_size (output samples discarded cold)
+    order: int
+    engine_req: str  # "auto" | "cascade" | "fft"
+    patch_out: int  # process_patch_size (stream chunk sizing)
+    # engine state (None/zero until the stream sees data)
+    kind: str | None = None  # "cascade" | "fft"
+    d_ns: int | None = None  # input sample step
+    n_ch: int | None = None
+    ratio: int | None = None  # cascade only
+    edge_in: int | None = None  # fft only: overlap-save halo, input samples
+    bufs: tuple = ()
+    residual: np.ndarray | None = None  # cascade: read-but-unconsumed rows
+    skip_left: int = 0  # outputs still to discard (warm-up + cold edge)
+    next_ingest_ns: int | None = None  # next input sample to read
+    next_emit_ns: int | None = None  # next output grid time to emit
+    last_emit_ns: int | None = None  # newest output written (reconcile key)
+    consumed: int = 0  # full-rate samples fed through the filter
+    emitted: int = 0  # output samples written
+    # latches False after a Pallas stream-step failure; lives on the
+    # carry (not the per-round LFProc) so a failing kernel is not
+    # re-dispatched every polling round or process restart
+    pallas_ok: bool = True
+
+    def _meta(self) -> dict:
+        return {
+            "version": _VERSION,
+            "start_ns": int(self.start_ns),
+            "step_ns": int(self.step_ns),
+            "dt_out": float(self.dt_out),
+            "buff_out": int(self.buff_out),
+            "order": int(self.order),
+            "engine_req": self.engine_req,
+            "patch_out": int(self.patch_out),
+            "kind": self.kind,
+            "d_ns": None if self.d_ns is None else int(self.d_ns),
+            "n_ch": None if self.n_ch is None else int(self.n_ch),
+            "ratio": None if self.ratio is None else int(self.ratio),
+            "edge_in": None if self.edge_in is None else int(self.edge_in),
+            "n_bufs": len(self.bufs),
+            "skip_left": int(self.skip_left),
+            "next_ingest_ns": _opt_int(self.next_ingest_ns),
+            "next_emit_ns": _opt_int(self.next_emit_ns),
+            "last_emit_ns": _opt_int(self.last_emit_ns),
+            "consumed": int(self.consumed),
+            "emitted": int(self.emitted),
+            "pallas_ok": bool(self.pallas_ok),
+        }
+
+
+def _opt_int(v):
+    return None if v is None else int(v)
+
+
+def save_carry(carry: StreamCarry, folder: str) -> str:
+    """Atomically persist the carry beside the output files: one
+    ``.npz`` (meta embedded, tmp-then-rename) plus a readable ``.json``
+    sidecar.  Returns the npz path."""
+    path = os.path.join(folder, CARRY_FILENAME)
+    arrays = {"meta": np.asarray(json.dumps(carry._meta()))}
+    for i, b in enumerate(carry.bufs):
+        arrays[f"buf_{i}"] = np.asarray(b, np.float32)
+    if carry.residual is not None:
+        arrays["residual"] = np.asarray(carry.residual, np.float32)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        np.savez(fh, **arrays)
+    os.replace(tmp, path)
+    side = os.path.join(folder, CARRY_SIDECAR)
+    tmp = side + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(carry._meta(), fh, indent=1)
+    os.replace(tmp, side)
+    return path
+
+
+def discard_carry(folder: str) -> bool:
+    """Remove a persisted carry (both files).  Any non-stateful round
+    that emits into the folder MUST call this: the carry's validity
+    rests on 'no output is newer than the carry', and a rewind-mode
+    write breaks that — a later stateful resume against the stale
+    carry would reconcile away valid (possibly irreplaceable) output
+    files.  Returns True when a carry was removed."""
+    removed = False
+    for name in (CARRY_FILENAME, CARRY_SIDECAR):
+        path = os.path.join(folder, name)
+        if os.path.isfile(path):
+            os.remove(path)
+            removed = True
+    if removed:
+        log_event("stream_carry_discarded", folder=folder)
+    return removed
+
+
+def load_carry(folder: str) -> StreamCarry | None:
+    """Load a previously saved carry, or None when absent or
+    unreadable (a corrupt carry must degrade to rewind mode, never
+    crash the realtime loop)."""
+    path = os.path.join(folder, CARRY_FILENAME)
+    if not os.path.isfile(path):
+        return None
+    try:
+        with np.load(path) as f:
+            meta = json.loads(str(f["meta"]))
+            if meta.get("version") != _VERSION:
+                log_event("stream_carry_version_skew", meta=meta)
+                return None
+            bufs = tuple(
+                f[f"buf_{i}"] for i in range(int(meta["n_bufs"]))
+            )
+            residual = f["residual"] if "residual" in f else None
+    except Exception as exc:
+        log_event("stream_carry_unreadable", error=str(exc)[:200])
+        return None
+    return StreamCarry(
+        start_ns=meta["start_ns"],
+        step_ns=meta["step_ns"],
+        dt_out=meta["dt_out"],
+        buff_out=meta["buff_out"],
+        order=meta["order"],
+        engine_req=meta["engine_req"],
+        patch_out=meta["patch_out"],
+        kind=meta["kind"],
+        d_ns=meta["d_ns"],
+        n_ch=meta["n_ch"],
+        ratio=meta["ratio"],
+        edge_in=meta["edge_in"],
+        bufs=bufs,
+        residual=residual,
+        skip_left=meta["skip_left"],
+        next_ingest_ns=meta["next_ingest_ns"],
+        next_emit_ns=meta["next_emit_ns"],
+        last_emit_ns=meta["last_emit_ns"],
+        consumed=meta["consumed"],
+        emitted=meta["emitted"],
+        pallas_ok=bool(meta.get("pallas_ok", True)),
+    )
+
+
+def reconcile_outputs(folder: str, carry: StreamCarry) -> int:
+    """Delete output files newer than the carry (a crash between the
+    round's output writes and its carry save leaves such files; they
+    are regenerated identically on resume).  Returns the count."""
+    if carry.last_emit_ns is None:
+        cutoff = None  # nothing emitted yet: every output is stale
+    else:
+        cutoff = np.datetime64(int(carry.last_emit_ns), "ns")
+    from tpudas.io.spool import spool as make_spool
+
+    try:
+        contents = make_spool(folder).update().get_contents()
+    except Exception:
+        return 0
+    removed = 0
+    for _, row in contents.iterrows():
+        t_min = np.datetime64(row["time_min"], "ns")
+        if cutoff is None or t_min > cutoff:
+            path = row.get("path")
+            if path and not os.path.isabs(path):
+                path = os.path.join(folder, path)
+            if path and os.path.isfile(path):
+                os.remove(path)
+                removed += 1
+    if removed:
+        log_event("stream_reconcile_removed", files=removed)
+    return removed
+
+
+# ---------------------------------------------------------------------------
+# the resumable engine
+
+
+def open_stream(lfp, start_time) -> StreamCarry:
+    """A fresh (unopened) carry for this LFProc's parameters, anchored
+    at ``start_time``.  Engine choice and buffer allocation happen on
+    first data (:func:`process_increment`)."""
+    from tpudas.core.timeutils import quantize_step
+
+    para = lfp.parameters
+    dt = float(para["output_sample_interval"])
+    step_ns = int(
+        quantize_step(dt).astype("timedelta64[ns]").astype(np.int64)
+    )
+    if step_ns <= 0:
+        raise ValueError(
+            f"output_sample_interval {dt} quantizes to a non-positive "
+            "ms grid step"
+        )
+    start_ns = int(
+        to_datetime64(start_time).astype("datetime64[ns]").astype(np.int64)
+    )
+    return StreamCarry(
+        start_ns=start_ns,
+        step_ns=step_ns,
+        dt_out=dt,
+        buff_out=int(para["edge_buff_size"]),
+        order=int(para["filter_order"]),
+        engine_req=str(para["engine"]),
+        patch_out=int(para["process_patch_size"]),
+    )
+
+
+def carry_matches(carry: StreamCarry, lfp, start_time=None) -> bool:
+    """Resume guard: the loaded carry must have been produced by the
+    same output-grid/filter/engine configuration — and, when
+    ``start_time`` is given, the same stream anchor (a moved start
+    cannot be honored by a continuing grid; the caller raises so the
+    operator deletes the carry instead of being silently ignored).
+    ``process_patch_size`` is NOT compared: it only shapes chunking,
+    and the caller refreshes it from the live parameters."""
+    para = lfp.parameters
+    from tpudas.core.timeutils import quantize_step
+
+    step_ns = int(
+        quantize_step(float(para["output_sample_interval"]))
+        .astype("timedelta64[ns]")
+        .astype(np.int64)
+    )
+    if start_time is not None:
+        start_ns = int(
+            to_datetime64(start_time)
+            .astype("datetime64[ns]")
+            .astype(np.int64)
+        )
+        if carry.start_ns != start_ns:
+            return False
+    return (
+        carry.step_ns == step_ns
+        and carry.buff_out == int(para["edge_buff_size"])
+        and carry.order == int(para["filter_order"])
+        and carry.engine_req == str(para["engine"])
+    )
+
+
+def _corner(dt: float) -> float:
+    from tpudas.proc.lfproc import output_corner
+
+    return output_corner(dt)
+
+
+def process_increment(lfp, carry: StreamCarry, edtime) -> int:
+    """Process all new data up to ``edtime`` through the carried
+    filter state; write outputs; update ``carry`` in place.  Returns
+    the number of output samples emitted.
+
+    Data is loaded in bounded time slices (one ``process_patch_size``
+    window's worth of inputs each) so a large backlog never materializes
+    at once; each slice flows through the stateful engine exactly once.
+    """
+    on_gap = lfp.parameters["on_gap"]
+    t2_ns = int(
+        to_datetime64(edtime).astype("datetime64[ns]").astype(np.int64)
+    )
+    emitted0 = carry.emitted
+    slice_ns = max(carry.patch_out, 4) * carry.step_ns
+    while True:
+        t_lo_ns = (
+            carry.next_ingest_ns
+            if carry.next_ingest_ns is not None
+            else carry.start_ns
+        )
+        if t_lo_ns > t2_ns:
+            break
+        t_hi_ns = min(t2_ns, t_lo_ns + slice_ns)
+        t_lo = np.datetime64(int(t_lo_ns), "ns")
+        t_hi = np.datetime64(int(t_hi_ns), "ns")
+        t0 = time.perf_counter()
+        patch = lfp._load_window(t_lo, t_hi, on_gap)
+        lfp.timings["assemble_s"] += time.perf_counter() - t0
+        if patch is None:
+            # unmergeable slice under a tolerant gap policy: skip it and
+            # cold-restart the engine at the next data (stream analogue
+            # of the batch path's skipped/split windows)
+            log_event(
+                "stream_gap_skipped", t_lo=str(t_lo), t_hi=str(t_hi)
+            )
+            _reset_engine(carry)
+            carry.next_ingest_ns = t_hi_ns + 1
+            if t_hi_ns >= t2_ns:
+                break
+            continue
+        _feed_patch(lfp, carry, patch, on_gap)
+        if (
+            carry.next_ingest_ns is None
+            or carry.next_ingest_ns <= t_lo_ns
+        ):
+            # the slice produced no ingest progress (e.g. a selection
+            # quirk returned only already-consumed samples) — forcing
+            # the cursor forward beats spinning on the same slice
+            log_event("stream_no_progress", t_lo=str(t_lo))
+            carry.next_ingest_ns = t_hi_ns + 1
+        if t_hi_ns >= t2_ns:
+            break
+    return carry.emitted - emitted0
+
+
+def _reset_engine(carry: StreamCarry) -> None:
+    carry.kind = None
+    carry.bufs = ()
+    carry.residual = None
+    carry.skip_left = 0
+    carry.ratio = None
+    carry.edge_in = None
+
+
+def _feed_patch(lfp, carry: StreamCarry, patch, on_gap) -> None:
+    """Feed one loaded window into the carried engine, emitting output
+    files for every grid point whose support is now complete."""
+    host, qs = lfp._time_major_payload(patch)
+    if qs is not None:
+        host = host.astype(np.float32) * np.float32(qs)
+    else:
+        host = np.asarray(host, np.float32)
+    t_ns = (
+        np.asarray(patch.coords["time"])
+        .astype("datetime64[ns]")
+        .astype(np.int64)
+    )
+    if t_ns.size == 0:
+        return
+    if carry.kind is None:
+        d_sec = patch.get_sample_step("time")
+        i0 = _open_engine(lfp, carry, host, t_ns, float(d_sec))
+    else:
+        if host.shape[1] != carry.n_ch:
+            raise ValueError(
+                f"stream channel count changed: {host.shape[1]} vs "
+                f"carry {carry.n_ch}"
+            )
+        d = carry.d_ns
+        i0 = int(np.searchsorted(t_ns, carry.next_ingest_ns - d // 2))
+        if i0 >= t_ns.size:
+            return  # slice contained only already-consumed samples
+        if t_ns[i0] - carry.next_ingest_ns > d // 2:
+            # data is missing between the carry position and this
+            # window — a real gap at full rate
+            log_event(
+                "stream_gap_detected",
+                expected=str(np.datetime64(int(carry.next_ingest_ns), "ns")),
+                got=str(np.datetime64(int(t_ns[i0]), "ns")),
+            )
+            if on_gap == "raise":
+                raise Exception("patch merge failed! Gap in data exists")
+            _reset_engine(carry)
+            d_sec = patch.get_sample_step("time")
+            i0 = _open_engine(
+                lfp, carry, host[i0:], t_ns[i0:], float(d_sec)
+            ) + i0
+    new = host[i0:]
+    new_t = t_ns[i0:]
+    if new.shape[0] == 0:
+        return
+    carry.next_ingest_ns = int(new_t[-1]) + carry.d_ns
+    if carry.kind == "cascade":
+        _consume_cascade(lfp, carry, patch, new)
+    else:
+        _consume_fft(lfp, carry, patch, new, int(new_t[0]))
+
+
+def _grid_ceil(carry: StreamCarry, t_ns: int) -> int:
+    """First output-grid time >= both t_ns and the grid anchor."""
+    k = max(0, -(-(int(t_ns) - carry.start_ns) // carry.step_ns))
+    return carry.start_ns + k * carry.step_ns
+
+
+def _open_engine(lfp, carry: StreamCarry, host, t_ns, d_sec) -> int:
+    """Choose and initialize the engine at the stream's first data.
+    Returns the index of the first input row to feed."""
+    d_ns = int(round(d_sec * 1e9))
+    if d_ns <= 0:
+        raise ValueError(f"non-positive input sample step {d_sec}")
+    t0 = int(t_ns[0])
+    g_e = _grid_ceil(carry, t0)  # first emittable grid point
+    step = carry.step_ns
+    n_ch = int(host.shape[1])
+    corner = _corner(carry.dt_out)
+
+    aligned = step % d_ns == 0 and (g_e - t0) % d_ns == 0
+    ratio = step // d_ns if aligned else 0
+    if aligned:
+        from tpudas.ops.fir import factor_ratio
+
+        try:
+            factor_ratio(ratio)
+        except ValueError:
+            aligned = False
+    if carry.engine_req == "fft":
+        aligned = False
+    if not aligned and carry.engine_req == "cascade":
+        raise ValueError(
+            "engine='cascade' requires the output grid to land on "
+            "input samples with an integer small-prime decimation "
+            "ratio; use engine='auto' or 'fft'"
+        )
+    carry.d_ns = d_ns
+    carry.n_ch = n_ch
+    if aligned:
+        from tpudas.ops.fir import (
+            cascade_stream_init,
+            design_cascade,
+            edge_support_samples,
+            stream_warmup_outputs,
+        )
+
+        plan = design_cascade(1e9 / d_ns, int(ratio), corner, carry.order)
+        supp = edge_support_samples(plan, 1e-3)
+        if carry.buff_out * step < supp * d_ns:
+            print(
+                "Warning: edge_buff_size halo is smaller than the "
+                f"cascade filter support ({supp} input samples); the "
+                "stream's first emitted samples may carry start "
+                "artifacts"
+            )
+            log_event("stream_halo_small", support=supp)
+        carry.kind = "cascade"
+        carry.ratio = int(ratio)
+        carry.skip_left = stream_warmup_outputs(plan) + carry.buff_out
+        carry.next_emit_ns = g_e + carry.buff_out * step
+        carry.bufs = cascade_stream_init(plan, n_ch)
+        # feed origin so that stream output (warmup + k) lands on grid
+        # point g_e + k*step: first fed sample at g_e - delay*d
+        t_feed0 = g_e - plan.delay * d_ns
+        if t_feed0 < t0:
+            prepad = (t0 - t_feed0) // d_ns
+            carry.residual = np.zeros((int(prepad), n_ch), np.float32)
+            i0 = 0
+        else:
+            carry.residual = np.zeros((0, n_ch), np.float32)
+            i0 = int((t_feed0 - t0) // d_ns)
+    else:
+        from tpudas.ops.filter import fft_stream_init
+
+        carry.kind = "fft"
+        carry.edge_in = int(-(-carry.buff_out * step // d_ns))
+        carry.next_emit_ns = g_e + carry.buff_out * step
+        carry.bufs = (
+            fft_stream_init(carry.edge_in, n_ch),
+            np.zeros((0, n_ch), np.float32),  # last-row lerp seam
+        )
+        carry.residual = None
+        i0 = 0
+    log_event(
+        "stream_open",
+        kind=carry.kind,
+        ratio=carry.ratio,
+        edge_in=carry.edge_in,
+        skip_left=carry.skip_left,
+        first_emit=str(np.datetime64(int(carry.next_emit_ns), "ns")),
+    )
+    return i0
+
+
+def _emit(lfp, carry: StreamCarry, patch, out, rows, ran, t_dev) -> None:
+    """Write ``out`` (n, C) at the carry's emission cursor."""
+    n = int(out.shape[0])
+    if n == 0:
+        return
+    times = (
+        carry.next_emit_ns + carry.step_ns * np.arange(n, dtype=np.int64)
+    ).astype("datetime64[ns]")
+    carry.next_emit_ns = int(carry.next_emit_ns + n * carry.step_ns)
+    carry.last_emit_ns = int(times[-1].astype(np.int64))
+    carry.emitted += n
+    lfp._emit_window_output(
+        patch, times, carry.dt_out, out, ran, rows=rows, t_dev=t_dev
+    )
+
+
+def _pow2_blocks(n_units: int, cap: int) -> list:
+    """Block sizes covering ``n_units``: whole ``cap``-sized blocks
+    first, then a descending power-of-two decomposition of the
+    remainder.  Every emitted size is either ``cap`` or a power of
+    two, so the jitted stream step compiles O(log) distinct shapes per
+    configuration instead of one per arrival size (a fresh trace per
+    round would cost more on TPU than the rewind this module
+    eliminates)."""
+    out = [cap] * (n_units // cap)
+    rem = n_units % cap
+    b = 1 << max(rem.bit_length() - 1, 0)
+    while rem:
+        if b <= rem:
+            out.append(b)
+            rem -= b
+        b >>= 1
+    return out
+
+
+def _pool_with_residual(carry: StreamCarry, new) -> np.ndarray:
+    residual = (
+        carry.residual
+        if carry.residual is not None
+        else np.zeros((0, carry.n_ch), np.float32)
+    )
+    return (
+        np.concatenate([residual, new], axis=0) if residual.size else new
+    )
+
+
+def _consume_cascade(lfp, carry: StreamCarry, patch, new) -> None:
+    from tpudas.ops.fir import (
+        cascade_decimate_stream,
+        design_cascade,
+        stream_stage_engines,
+    )
+
+    plan = design_cascade(
+        1e9 / carry.d_ns, carry.ratio, _corner(carry.dt_out), carry.order
+    )
+    pool = _pool_with_residual(carry, new)
+    usable = pool.shape[0] - pool.shape[0] % carry.ratio
+    eng_req = "auto" if (lfp._pallas_ok and carry.pallas_ok) else "xla"
+    off = 0
+    for n_out in _pow2_blocks(usable // carry.ratio, carry.patch_out):
+        blk = pool[off : off + n_out * carry.ratio]
+        stages = stream_stage_engines(
+            plan, blk.shape[0], carry.n_ch, eng_req
+        )
+        ran = "cascade-pallas" if "pallas" in stages else "cascade-xla"
+        # the stream step donates the carry on accelerators, so a
+        # fallback retry must not reuse buffers the failed dispatch
+        # already consumed — snapshot them first (Pallas blocks only)
+        backup = (
+            tuple(np.asarray(b) for b in carry.bufs)
+            if ran == "cascade-pallas"
+            else None
+        )
+        t0 = time.perf_counter()
+        try:
+            y, bufs = cascade_decimate_stream(
+                blk, carry.bufs, plan, eng_req
+            )
+        except Exception as exc:
+            # mirror the batch path's Pallas resilience: a fast-path
+            # failure degrades to the XLA formulation for the rest of
+            # the run instead of killing the stream
+            if ran != "cascade-pallas":
+                raise
+            print(
+                "Warning: Pallas kernel failed in the stream path "
+                f"({str(exc)[:120]}); falling back to the XLA cascade"
+            )
+            log_event("stream_pallas_fallback", error=str(exc)[:300])
+            lfp._pallas_ok = False
+            carry.pallas_ok = False  # persists across rounds/restarts
+            eng_req = "xla"
+            ran = "cascade-xla"
+            y, bufs = cascade_decimate_stream(blk, backup, plan, eng_req)
+        y = np.asarray(y)
+        t_dev = time.perf_counter() - t0
+        lfp.timings["device_s"] += t_dev
+        carry.bufs = bufs
+        carry.consumed += int(blk.shape[0])
+        s = min(carry.skip_left, y.shape[0])
+        carry.skip_left -= s
+        _emit(lfp, carry, patch, y[s:], rows=int(blk.shape[0]), ran=ran,
+              t_dev=t_dev)
+        off += blk.shape[0]
+    carry.residual = np.ascontiguousarray(pool[usable:])
+
+
+# FFT stream feed quantum (input samples): block sizes are multiples
+# of this, power-of-two decomposed, so the filter kernel compiles a
+# bounded set of shapes; up to QUANTUM-1 samples wait in the residual
+# until the next feed (bounded, sub-second extra head lag)
+_FFT_QUANTUM = 128
+
+
+def _consume_fft(lfp, carry: StreamCarry, patch, new, t_new0_ns) -> None:
+    from tpudas.ops.filter import fft_pass_filter_stream
+
+    d = carry.d_ns
+    corner = _corner(carry.dt_out)
+    q = _FFT_QUANTUM
+    pool = _pool_with_residual(carry, new)
+    t_pool0_ns = t_new0_ns - (pool.shape[0] - new.shape[0]) * d
+    usable = pool.shape[0] - pool.shape[0] % q
+    cap_units = max(
+        1, carry.patch_out * max(1, carry.step_ns // d) // q
+    )
+    off = 0
+    for n_units in _pow2_blocks(usable // q, cap_units):
+        blk = pool[off : off + n_units * q]
+        t0 = time.perf_counter()
+        filt, fcarry = fft_pass_filter_stream(
+            blk, carry.bufs[0], d / 1e9, high=corner, order=carry.order
+        )
+        filt = np.asarray(filt)
+        t_dev = time.perf_counter() - t0
+        lfp.timings["device_s"] += t_dev
+        tail = carry.bufs[1]
+        rows = (
+            np.concatenate([tail, filt], axis=0) if tail.size else filt
+        )
+        # row j is the filtered stream at the position edge_in samples
+        # behind its input; the stored tail row extends the seam left
+        t_row0 = (
+            t_pool0_ns
+            + off * d
+            - carry.edge_in * d
+            - (tail.shape[0]) * d
+        )
+        t_last = t_row0 + (rows.shape[0] - 1) * d
+        carry.bufs = (np.asarray(fcarry), rows[-1:].copy())
+        carry.consumed += int(blk.shape[0])
+        off += blk.shape[0]
+        if t_last < carry.next_emit_ns or rows.shape[0] < 2:
+            continue
+        n = int((t_last - carry.next_emit_ns) // carry.step_ns) + 1
+        g = carry.next_emit_ns + carry.step_ns * np.arange(
+            n, dtype=np.int64
+        )
+        offs = g - t_row0
+        idx = offs // d
+        w = (offs - idx * d) / float(d)
+        sel = idx >= rows.shape[0] - 1
+        idx[sel] = rows.shape[0] - 2
+        w[sel] = 1.0
+        out = rows[idx] * (1.0 - w[:, None]).astype(np.float32) + rows[
+            idx + 1
+        ] * w[:, None].astype(np.float32)
+        s = min(carry.skip_left, out.shape[0])
+        carry.skip_left -= s
+        _emit(
+            lfp, carry, patch, out[s:].astype(np.float32, copy=False),
+            rows=int(blk.shape[0]), ran="fft", t_dev=t_dev,
+        )
+    carry.residual = np.ascontiguousarray(pool[usable:])

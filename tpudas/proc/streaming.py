@@ -13,11 +13,22 @@ sample past the last emitted one, so resumed output is seam-free.
 ``max(125 s, file duration, 3 * edge_buffer)``
 (low_pass_dascore_edge.ipynb:165-173); tests inject ``sleep_fn`` and
 ``max_rounds``.
+
+Stateful streaming (default): instead of the rewind, the low-pass
+driver carries each filter stage's O(1) state across rounds
+(tpudas.proc.stream) — no re-read, no re-filter; per-round work drops
+from O(window + 2*edge) to O(window) full-rate samples and the carry
+serializes beside the outputs so a crash resumes from O(1) state.
+``TPUDAS_STREAM_STATEFUL=0`` (or ``stateful=False``) restores the
+reference's rewind behavior; joint/mesh/window-DP runs and legacy
+output folders (outputs but no carry) use the rewind path
+automatically.
 """
 
 from __future__ import annotations
 
 import math
+import os
 import time as _time
 
 import numpy as np
@@ -109,6 +120,7 @@ def run_lowpass_realtime(
     rolling_output_folder=None,
     rolling_window=None,
     rolling_step=None,
+    stateful=None,
 ):
     """Poll ``source`` and keep the low-pass output current.
 
@@ -129,6 +141,14 @@ def run_lowpass_realtime(
     rolling-grid alignment use a ``rolling_step`` that divides
     ``output_sample_interval`` (each round's grid is anchored at its
     own resume point, which sits on the output grid).
+
+    ``stateful`` selects the carried-filter-state execution mode
+    (default: on, via ``TPUDAS_STREAM_STATEFUL`` — "0" restores the
+    rewind): each round processes ONLY new full-rate samples through
+    :meth:`LFProc.process_stream_increment` and persists the O(1)
+    carry beside the outputs for crash-only resume.  Joint products,
+    meshes, and window-DP stay on the rewind path, as does a legacy
+    output folder that has files but no carry.
 
     Returns the number of rounds that processed data. Terminates when a
     poll sees no new files (reference semantics) or after
@@ -159,11 +179,21 @@ def run_lowpass_realtime(
     }
     counters = counters if counters is not None else Counters()
 
+    if stateful is None:
+        stateful = os.environ.get("TPUDAS_STREAM_STATEFUL", "1") != "0"
+    stateful = bool(stateful) and (
+        rolling_output_folder is None and mesh is None and not window_dp
+    )
+    carry = None  # the cross-round filter state (stateful mode)
+    carry_checked = False  # disk/legacy resolution happens once
+    rewind_wrote = False  # first rewind write invalidates any carry
+
     processed_once = False  # first PROCESSING round always starts at
     # start_time, however many empty polls precede it (a pre-existing
     # output folder must not hijack the user's start point)
     rounds = 0
     polls = 0
+    prev_t2 = None  # previous round's processing head (redundancy metric)
     len_last = None  # spool size at the previous poll (None = no poll yet)
     while True:
         polls += 1
@@ -201,31 +231,127 @@ def run_lowpass_realtime(
                 )
             rounds += 1
             print("run number: ", rounds)
-            if not processed_once:
-                t1 = start_time
-            else:
-                try:
-                    t_last = lfp.get_last_processed_time()
-                except IndexError:
-                    # a prior round completed without emitting output
-                    # (stream still shorter than the edge trim) — no
-                    # checkpoint yet, retry from the very start
-                    t_last = None
-                if t_last is None:
-                    t1 = start_time
+            if stateful and not carry_checked:
+                # one-time disk resolution: resume a persisted carry,
+                # or fall back to rewind mode for a legacy folder that
+                # has outputs but no carry (its resume point is only
+                # expressible as a rewind)
+                carry_checked = True
+                from tpudas.proc.stream import (
+                    carry_matches,
+                    load_carry,
+                    reconcile_outputs,
+                )
+
+                carry = load_carry(output_folder)
+                if carry is not None and not carry_matches(
+                    carry, lfp, start_time
+                ):
+                    raise ValueError(
+                        "persisted stream carry in "
+                        f"{output_folder} was produced under a "
+                        "different start_time or processing "
+                        "parameters; delete it (or the folder) to "
+                        "change configuration"
+                    )
+                if carry is not None:
+                    # patch_size only shapes chunking — honor the
+                    # live setting rather than the persisted one
+                    carry.patch_out = int(process_patch_size)
+                    reconcile_outputs(output_folder, carry)
+                    log_event("stream_resume", emitted=carry.emitted)
                 else:
-                    # rewind (ceil(edge/dt) - 1) output steps, exactly
-                    # on the output grid — ns precision so fractional
-                    # d_t stays seam-free (the resumed run's first
-                    # emitted sample is then t_last + d_t)
-                    rewind_sec = (math.ceil(edge_buffer / d_t) - 1) * d_t
-                    t1 = t_last - to_timedelta64(rewind_sec)
+                    try:
+                        lfp.get_last_processed_time()
+                        has_outputs = True
+                    except Exception:
+                        has_outputs = False
+                    if has_outputs:
+                        stateful = False
+                        print(
+                            "Existing output folder has no stream "
+                            "carry; continuing in rewind mode"
+                        )
+                        log_event("stream_legacy_rewind")
+                    else:
+                        carry = lfp.open_stream(start_time)
+                        # persist BEFORE the first outputs: a crash
+                        # mid-round-1 then still reads as a stateful
+                        # folder (reconcile + resume) instead of
+                        # degrading to rewind mode forever via the
+                        # legacy heuristic above
+                        from tpudas.proc.stream import save_carry
+
+                        save_carry(carry, output_folder)
             # newest timestamp from the index — no file data is read
             contents = sub.get_contents()
             t2 = np.datetime64(contents["time_max"].max())
-            data_sec, ch_samples = _covered_workload(contents, t1, t2)
-            with counters.measure(int(ch_samples), data_sec):
-                lfp.process_time_range(t1, t2)
+            redundant = 0.0
+            if stateful:
+                # carried state: only NEW samples are read/filtered
+                t1 = (
+                    np.datetime64(int(carry.next_ingest_ns), "ns")
+                    if carry.next_ingest_ns is not None
+                    else start_time
+                )
+                data_sec, ch_samples = _covered_workload(contents, t1, t2)
+                with counters.measure(int(ch_samples), data_sec):
+                    lfp.process_stream_increment(carry, t2)
+                from tpudas.proc.stream import save_carry
+
+                # saved AFTER the outputs: the carry is never ahead of
+                # the files (crash-only; resume reconciles the rest)
+                save_carry(carry, output_folder)
+            else:
+                resumed_stateful = False
+                if not rewind_wrote:
+                    # a persisted carry means the folder head was
+                    # written by the stateful mode; this rewind write
+                    # breaks the carry's no-newer-outputs invariant,
+                    # so invalidate it — and CONTINUE from the folder
+                    # head (the t_last resume below) rather than
+                    # reprocessing from start_time, leaving every
+                    # stateful-era product file untouched
+                    rewind_wrote = True
+                    from tpudas.proc.stream import discard_carry
+
+                    if discard_carry(output_folder):
+                        resumed_stateful = True
+                        print(
+                            "Removed stale stream carry; rewind mode "
+                            "continues from the folder head"
+                        )
+                if not processed_once and not resumed_stateful:
+                    t1 = start_time
+                else:
+                    try:
+                        t_last = lfp.get_last_processed_time()
+                    except IndexError:
+                        # a prior round completed without emitting output
+                        # (stream still shorter than the edge trim) — no
+                        # checkpoint yet, retry from the very start
+                        t_last = None
+                    if t_last is None:
+                        t1 = start_time
+                    else:
+                        # rewind (ceil(edge/dt) - 1) output steps, exactly
+                        # on the output grid — ns precision so fractional
+                        # d_t stays seam-free (the resumed run's first
+                        # emitted sample is then t_last + d_t)
+                        rewind_sec = (math.ceil(edge_buffer / d_t) - 1) * d_t
+                        t1 = t_last - to_timedelta64(rewind_sec)
+                data_sec, ch_samples = _covered_workload(contents, t1, t2)
+                if prev_t2 is not None and t1 < prev_t2:
+                    # full-rate samples re-read solely to rebuild the
+                    # filter's transient state (what stateful mode
+                    # eliminates)
+                    _, redundant = _covered_workload(
+                        contents, t1, min(prev_t2, t2)
+                    )
+                    counters.add_redundant(int(redundant))
+                with counters.measure(int(ch_samples), data_sec):
+                    lfp.process_time_range(t1, t2)
+            prev_t2 = t2
             round_rt = (
                 data_sec / counters.last_wall
                 if counters.last_wall
@@ -235,7 +361,9 @@ def run_lowpass_realtime(
                 "realtime_round",
                 round=rounds,
                 upto=str(t2),
+                mode="stateful" if stateful else "rewind",
                 data_seconds=round(data_sec, 3),
+                redundant_samples=int(redundant),
                 wall_seconds=round(counters.last_wall, 4),
                 realtime_factor=round(round_rt, 2),
                 engine=lfp.parameters["engine"],

@@ -32,6 +32,11 @@ class Counters:
         self.data_seconds = 0.0
         self.wall_seconds = 0.0
         self.last_wall = 0.0  # duration of the most recent measure()
+        # full-rate channel-samples processed MORE than once (the
+        # rewind-mode edge-buffer re-reads; 0 under stateful streaming,
+        # where carried filter state makes every sample touch the
+        # filter exactly once)
+        self.samples_redundant = 0
 
     @contextmanager
     def measure(self, channel_samples: int, data_seconds: float):
@@ -41,6 +46,19 @@ class Counters:
         self.wall_seconds += self.last_wall
         self.channel_samples += int(channel_samples)
         self.data_seconds += float(data_seconds)
+
+    def add_redundant(self, channel_samples: int) -> None:
+        """Record channel-samples that were re-read/re-filtered solely
+        to rebuild filter state (rewind-mode overlap)."""
+        self.samples_redundant += int(channel_samples)
+
+    @property
+    def redundant_ratio(self) -> float:
+        """Fraction of all processed channel-samples that were
+        redundant re-reads (0.0 for a stateful stream)."""
+        if not self.channel_samples:
+            return 0.0
+        return self.samples_redundant / self.channel_samples
 
     @property
     def channel_samples_per_sec(self) -> float:
