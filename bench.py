@@ -583,14 +583,29 @@ def _e2e_child(backend: str) -> None:
             lfp.set_rolling_output_folder(out_roll, delete_existing=True)
         t0 = _np.datetime64(start)
         t1 = t0 + _np.timedelta64(sec, "s")
-        w0 = time.perf_counter()
-        lfp.process_time_range(t0, t1)
-        elapsed = time.perf_counter() - w0
+        # measured through the obs registry (Counters mirrors into
+        # tpudas_proc_*) so the headline below and metrics.prom report
+        # the same numbers (ISSUE 2 satellite); a FRESH registry scope
+        # per run, so repeated in-process invocations (tests) do not
+        # accumulate
+        from tpudas.obs.registry import (
+            MetricsRegistry as _MetricsRegistry,
+            headline as _headline,
+            use_registry as _use_registry,
+        )
+        from tpudas.utils.profiling import Counters as _Counters
+
+        counters = _Counters()
+        with _use_registry(_MetricsRegistry()) as _reg:
+            with counters.measure(int(sec * fs * C), float(sec)):
+                lfp.process_time_range(t0, t1)
+        elapsed = counters.last_wall
         n_out = len(os.listdir(out))
         n_roll = len(os.listdir(out_roll)) if joint else None
 
-    value = sec * fs * C / elapsed
-    samples = sec * fs * C
+    h = _headline(_reg)
+    value = h["channel_samples_per_sec"]
+    samples = h["channel_samples"]
     # per-phase wall seconds from LFProc's own accounting (assemble =
     # waiting on the prefetch thread's window read+H2D staging, device
     # = kernel dispatch through host sync, write = HDF5 output) and the
@@ -610,7 +625,8 @@ def _e2e_child(backend: str) -> None:
                 "value": round(value, 1),
                 "unit": "channel_samples/sec",
                 "vs_baseline": round(value / 1e8, 4),
-                "realtime_factor": round(sec / elapsed, 2),
+                "realtime_factor": round(h["realtime_factor"], 2),
+                "headline_source": "tpudas.obs.registry",
                 "backend": backend,
                 "engine": engine,
                 "mode": "e2e",
@@ -776,8 +792,25 @@ def _child() -> None:
                 kernel, T_used, C, iters_fb, include_h2d
             )
 
-    channel_samples = T_used * C * iters_done
-    value = channel_samples / elapsed
+    # headline through the obs registry: the measured loop is absorbed
+    # into the tpudas_proc_* counters (Counters.add_measured) and the
+    # reported numbers are read back from there, the same substrate a
+    # deployment's metrics.prom scrapes (ISSUE 2 satellite); fresh
+    # registry scope so in-process re-runs (tests) don't accumulate
+    from tpudas.obs.registry import (
+        MetricsRegistry as _MetricsRegistry,
+        headline as _headline,
+        use_registry as _use_registry,
+    )
+    from tpudas.utils.profiling import Counters as _Counters
+
+    with _use_registry(_MetricsRegistry()) as _reg:
+        _Counters().add_measured(
+            T_used * C * iters_done, T_used * iters_done / fs, elapsed
+        )
+    _h = _headline(_reg)
+    channel_samples = _h["channel_samples"]
+    value = _h["channel_samples_per_sec"]
     flops_per_sec = flops_win * iters_done / elapsed
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
     peak = _PEAK_FP32.get(gen)
@@ -786,7 +819,8 @@ def _child() -> None:
         "value": round(value, 1),
         "unit": "channel_samples/sec",
         "vs_baseline": round(value / 1e8, 4),
-        "realtime_factor": round(T_used * iters_done / fs / elapsed, 2),
+        "realtime_factor": round(_h["realtime_factor"], 2),
+        "headline_source": "tpudas.obs.registry",
         "backend": backend,
         "engine": engine + ("-pallas" if use_pallas else ""),
         "shape": [T_used, C],

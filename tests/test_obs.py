@@ -1,0 +1,495 @@
+"""tpudas.obs: metrics registry, span tracing, edge health snapshot.
+
+Pins the ISSUE 2 contracts:
+- registry: thread-safe counters/gauges/histograms with labels, name
+  validation, Prometheus exposition golden format;
+- spans: nesting/parenting, ring-buffer eviction, registry feed,
+  log_event export;
+- health: atomic ``health.json`` (torn primary falls back to the
+  previous good snapshot), ``metrics.prom`` exposition, and the
+  realtime driver producing BOTH every round under ``TPUDAS_HEALTH=1``
+  (schema-checked);
+- satellites: ``log_event`` drop counting, Counters-to-registry
+  mirroring, ``device_trace`` env-var logdir.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from tpudas.obs.health import (
+    HEALTH_FILENAME,
+    PROM_FILENAME,
+    read_health,
+    validate_health,
+    write_health,
+    write_prom,
+)
+from tpudas.obs.registry import (
+    DEFAULT_BUCKETS,
+    MetricsRegistry,
+    get_registry,
+    headline,
+    use_registry,
+)
+from tpudas.obs.trace import clear_spans, get_spans, span
+
+T0 = np.datetime64("2023-03-22T00:00:00")
+
+
+def _payload(**over):
+    base = {
+        "rounds": 3,
+        "polls": 4,
+        "mode": "stateful",
+        "realtime_factor": 120.5,
+        "round_realtime_factor": 118.0,
+        "head_lag_seconds": 12.0,
+        "redundant_ratio": 0.0,
+        "carry_resume_count": 1,
+        "last_round_wall_seconds": 0.25,
+        "last_error": None,
+    }
+    base.update(over)
+    return base
+
+
+class TestRegistry:
+    def test_counter_gauge_histogram_basics(self):
+        reg = MetricsRegistry()
+        c = reg.counter("tpudas_test_total", "t")
+        c.inc()
+        c.inc(2.5)
+        assert c.value() == 3.5
+        g = reg.gauge("tpudas_test_gauge", "t")
+        g.set(7)
+        g.inc()
+        g.dec(0.5)
+        assert g.value() == 7.5
+        h = reg.histogram("tpudas_test_seconds", "t", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(5.0)
+        snap = h.snapshot()
+        assert snap["count"] == 3
+        assert snap["buckets"] == {0.1: 1, 1.0: 2}
+        assert snap["sum"] == pytest.approx(5.55)
+
+    def test_labels(self):
+        reg = MetricsRegistry()
+        c = reg.counter("tpudas_test_total", "t", labelnames=("engine",))
+        c.inc(engine="fft")
+        c.inc(3, engine="cascade")
+        assert c.value(engine="fft") == 1
+        assert c.value(engine="cascade") == 3
+        with pytest.raises(ValueError):
+            c.inc()  # missing declared label
+        with pytest.raises(ValueError):
+            c.inc(wrong="x")
+
+    def test_name_and_type_validation(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError):
+            reg.counter("not_tpudas_name")
+        with pytest.raises(ValueError):
+            reg.counter("tpudas_Bad_Case")
+        reg.counter("tpudas_test_total")
+        with pytest.raises(TypeError):
+            reg.gauge("tpudas_test_total")
+        with pytest.raises(ValueError):
+            reg.counter("tpudas_test_total", labelnames=("engine",))
+        with pytest.raises(ValueError):
+            reg.counter("tpudas_test_total").inc(-1)
+
+    def test_concurrent_increments_one_counter(self):
+        """The ISSUE-named concurrency contract: N threads hammering
+        one counter lose no increments."""
+        reg = MetricsRegistry()
+        c = reg.counter("tpudas_test_total", "t")
+        n_threads, per_thread = 8, 2000
+
+        def work():
+            for _ in range(per_thread):
+                c.inc()
+
+        threads = [threading.Thread(target=work) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value() == n_threads * per_thread
+
+    def test_use_registry_scopes_process_registry(self):
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            get_registry().counter("tpudas_test_total", "t").inc(5)
+        assert reg.value("tpudas_test_total") == 5
+        # out of scope: the process registry is a different object
+        assert get_registry() is not reg
+
+    def test_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("TPUDAS_OBS", "0")
+        reg = get_registry()
+        reg.counter("anything goes here").inc()  # no validation, no-op
+        assert reg.snapshot() == {}
+        assert reg.to_prometheus() == ""
+        monkeypatch.setenv("TPUDAS_OBS", "1")
+        assert get_registry() is not reg
+
+    def test_explicit_scope_overrides_kill_switch(self, monkeypatch):
+        """The bench.py pattern: a caller that installed its own
+        registry asked for measurements — TPUDAS_OBS=0 must not hand
+        it silent zeros (code-review finding on the e2e headline)."""
+        from tpudas.utils.profiling import Counters
+
+        monkeypatch.setenv("TPUDAS_OBS", "0")
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            assert get_registry() is reg
+            Counters().add_measured(1_000_000, 10.0, 2.0)
+            with span("stream.round"):
+                pass
+        h = headline(reg)
+        assert h["channel_samples"] == 1_000_000
+        assert h["realtime_factor"] == pytest.approx(5.0)
+        assert reg.get("tpudas_span_seconds").snapshot(
+            name="stream.round"
+        )["count"] == 1
+        # scope closed: the kill-switch applies again
+        assert get_registry().snapshot() == {}
+
+    def test_prometheus_exposition_golden(self):
+        """Exposition format pinned token-for-token (a scraper parses
+        this; drift is a breaking change)."""
+        reg = MetricsRegistry()
+        reg.counter(
+            "tpudas_test_total", "events so far", labelnames=("mode",)
+        ).inc(3, mode="stateful")
+        reg.gauge("tpudas_test_lag_seconds", "head lag").set(12.5)
+        h = reg.histogram(
+            "tpudas_test_seconds", "round time", buckets=(0.1, 1.0)
+        )
+        h.observe(0.05)
+        h.observe(0.7)
+        expected = (
+            "# HELP tpudas_test_lag_seconds head lag\n"
+            "# TYPE tpudas_test_lag_seconds gauge\n"
+            "tpudas_test_lag_seconds 12.5\n"
+            "# HELP tpudas_test_seconds round time\n"
+            "# TYPE tpudas_test_seconds histogram\n"
+            'tpudas_test_seconds_bucket{le="0.1"} 1\n'
+            'tpudas_test_seconds_bucket{le="1"} 2\n'
+            'tpudas_test_seconds_bucket{le="+Inf"} 2\n'
+            "tpudas_test_seconds_sum 0.75\n"
+            "tpudas_test_seconds_count 2\n"
+            "# HELP tpudas_test_total events so far\n"
+            "# TYPE tpudas_test_total counter\n"
+            'tpudas_test_total{mode="stateful"} 3\n'
+        )
+        assert reg.to_prometheus() == expected
+
+    def test_prometheus_label_escaping(self):
+        reg = MetricsRegistry()
+        reg.counter(
+            "tpudas_test_total", "t", labelnames=("path",)
+        ).inc(path='a"b\\c\nd')
+        text = reg.to_prometheus()
+        assert '{path="a\\"b\\\\c\\nd"}' in text
+
+    def test_headline_derivation(self):
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            from tpudas.utils.profiling import Counters
+
+            ctr = Counters()
+            ctr.add_measured(1000, 10.0, 2.0)
+            ctr.add_redundant(100)
+        h = headline(reg)
+        assert h["channel_samples"] == 1000
+        assert h["realtime_factor"] == pytest.approx(5.0)
+        assert h["channel_samples_per_sec"] == pytest.approx(500.0)
+        assert h["redundant_ratio"] == pytest.approx(0.1)
+        # instance accumulator and registry agree (the "can never
+        # disagree" satellite)
+        assert ctr.realtime_factor == pytest.approx(h["realtime_factor"])
+
+
+class TestSpans:
+    def setup_method(self):
+        clear_spans()
+
+    def test_nesting_and_attrs(self):
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            with span("outer", round=1) as outer:
+                with span("inner") as inner:
+                    assert inner["depth"] == 1
+                    assert inner["parent"] == outer["id"]
+        recs = get_spans()
+        # inner finishes (and lands in the ring) first
+        assert [r["name"] for r in recs] == ["inner", "outer"]
+        assert recs[1]["attrs"] == {"round": 1}
+        assert recs[0]["duration_s"] >= 0
+        # both fed the span histogram
+        snap = reg.get("tpudas_span_seconds").snapshot(name="outer")
+        assert snap["count"] == 1
+
+    def test_exception_recorded_and_propagated(self):
+        with use_registry(MetricsRegistry()):
+            with pytest.raises(RuntimeError):
+                with span("boom"):
+                    raise RuntimeError("kaput")
+        (rec,) = get_spans("boom")
+        assert "RuntimeError" in rec["error"]
+
+    def test_ring_eviction_bounded(self, monkeypatch):
+        monkeypatch.setenv("TPUDAS_SPAN_RING", "16")
+        clear_spans()
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            for i in range(40):
+                with span("tick", i=i):
+                    pass
+        recs = get_spans()
+        assert len(recs) == 16  # bounded
+        # newest survive, oldest evicted
+        assert [r["attrs"]["i"] for r in recs] == list(range(24, 40))
+        assert reg.value("tpudas_spans_evicted_total") == 24
+        monkeypatch.delenv("TPUDAS_SPAN_RING")
+        clear_spans()
+
+    def test_log_event_export(self):
+        from tpudas.utils.logging import set_log_handler
+
+        events = []
+        set_log_handler(events.append)
+        try:
+            with use_registry(MetricsRegistry()):
+                with span("exported", mode="test"):
+                    pass
+        finally:
+            set_log_handler(None)
+        (ev,) = [e for e in events if e["event"] == "span"]
+        assert ev["span"] == "exported"
+        assert ev["mode"] == "test"
+        assert ev["duration_s"] >= 0
+
+    def test_disabled_under_kill_switch(self, monkeypatch):
+        clear_spans()
+        monkeypatch.setenv("TPUDAS_OBS", "0")
+        with span("invisible"):
+            pass
+        monkeypatch.delenv("TPUDAS_OBS")
+        assert get_spans("invisible") == []
+
+
+class TestHealth:
+    def test_write_read_roundtrip(self, tmp_path):
+        with use_registry(MetricsRegistry()):
+            path = write_health(str(tmp_path), _payload())
+        assert path == str(tmp_path / HEALTH_FILENAME)
+        got = read_health(str(tmp_path))
+        assert got["rounds"] == 3
+        assert got["schema"] == 1
+        assert got["written_at"] > 0
+        # no stray tmp file left behind
+        assert sorted(os.listdir(tmp_path)) == [HEALTH_FILENAME]
+
+    def test_torn_primary_falls_back_to_previous_good(self, tmp_path):
+        with use_registry(MetricsRegistry()):
+            write_health(str(tmp_path), _payload(rounds=1))
+            write_health(str(tmp_path), _payload(rounds=2))
+        # simulate a torn/partial read of the primary (non-atomic copy
+        # mid-write): truncated JSON
+        primary = tmp_path / HEALTH_FILENAME
+        primary.write_text(primary.read_text()[: 17])
+        got = read_health(str(tmp_path))
+        assert got is not None and got["rounds"] == 1  # last GOOD
+        # both unreadable -> None
+        (tmp_path / (HEALTH_FILENAME + ".prev")).write_text("{not json")
+        assert read_health(str(tmp_path)) is None
+
+    def test_invalid_payload_counted_not_raised(self, tmp_path):
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            assert write_health(str(tmp_path), {"rounds": 1}) is None
+        assert reg.value("tpudas_health_write_errors_total") == 1
+        assert read_health(str(tmp_path)) is None
+
+    def test_validate_schema(self):
+        validate_health({**_payload(), "schema": 1, "written_at": 0.0})
+        with pytest.raises(ValueError):
+            validate_health(
+                {**_payload(), "schema": 99, "written_at": 0.0}
+            )
+
+    def test_write_prom(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.counter("tpudas_test_total", "t").inc(2)
+        with use_registry(reg):
+            path = write_prom(str(tmp_path))
+        assert path == str(tmp_path / PROM_FILENAME)
+        text = (tmp_path / PROM_FILENAME).read_text()
+        assert "tpudas_test_total 2\n" in text
+        assert "# TYPE tpudas_test_total counter" in text
+
+
+class TestRealtimeHealth:
+    def test_stateful_run_writes_health_and_prom_each_round(
+        self, tmp_path, monkeypatch
+    ):
+        """The acceptance criterion: a stateful realtime run with
+        TPUDAS_HEALTH=1 drops a schema-valid health.json + parseable
+        metrics.prom after EVERY processing round."""
+        from tpudas.proc.streaming import run_lowpass_realtime
+        from tpudas.testing import make_synthetic_spool
+
+        monkeypatch.setenv("TPUDAS_HEALTH", "1")
+        src = str(tmp_path / "raw")
+        out = str(tmp_path / "results")
+        make_synthetic_spool(
+            src, n_files=3, file_duration=30.0, fs=100.0, n_ch=6,
+            noise=0.01,
+        )
+        from tpudas.testing import synthetic_patch
+        from tpudas.io.registry import write_patch
+
+        state = {"fed": 0}
+
+        def fake_sleep(_):
+            if state["fed"] < 1:
+                state["fed"] += 1
+                t0 = T0.astype("datetime64[ns]")
+                step = np.timedelta64(int(round(1e9 / 100.0)), "ns")
+                n = int(30.0 * 100.0)
+                for i in range(3, 5):
+                    p = synthetic_patch(
+                        t0=t0 + i * n * step, duration=30.0, fs=100.0,
+                        n_ch=6, seed=i, phase_origin=t0, noise=0.01,
+                    )
+                    write_patch(
+                        p, os.path.join(src, f"raw2_{i:04d}.h5")
+                    )
+
+        seen = []
+
+        def on_round(rounds, lfp):
+            got = read_health(out)
+            assert got is not None, f"no health.json after round {rounds}"
+            seen.append(got)
+            prom = open(os.path.join(out, PROM_FILENAME)).read()
+            assert "tpudas_stream_rounds_total" in prom
+            assert "tpudas_proc_channel_samples_total" in prom
+
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            rounds = run_lowpass_realtime(
+                source=src,
+                output_folder=out,
+                start_time=str(T0),
+                output_sample_interval=1.0,
+                edge_buffer=8.0,
+                process_patch_size=40,
+                poll_interval=0.0,
+                file_duration=0.0,
+                sleep_fn=fake_sleep,
+                on_round=on_round,
+            )
+        assert rounds == 2
+        assert len(seen) == 2
+        last = seen[-1]
+        assert last["mode"] == "stateful"
+        assert last["rounds"] == 2
+        assert last["last_error"] is None
+        assert last["realtime_factor"] > 0
+        assert last["head_lag_seconds"] is not None
+        assert last["redundant_ratio"] == 0.0
+        # registry saw the same run
+        assert reg.value(
+            "tpudas_stream_rounds_total", mode="stateful"
+        ) == 2
+        assert reg.value("tpudas_stream_carry_saves_total") >= 2
+        assert headline(reg)["realtime_factor"] == pytest.approx(
+            last["realtime_factor"], abs=0.01
+        )
+
+    def test_crash_writes_last_error(self, tmp_path, monkeypatch):
+        from tpudas.proc.streaming import run_lowpass_realtime
+
+        monkeypatch.setenv("TPUDAS_HEALTH", "1")
+        out = str(tmp_path / "results")
+        os.makedirs(out)
+
+        def boom_sleep(_):
+            raise RuntimeError("interrogator unplugged")
+
+        with use_registry(MetricsRegistry()):
+            with pytest.raises(Exception):
+                run_lowpass_realtime(
+                    source=str(tmp_path / "missing"),
+                    output_folder=out,
+                    start_time=str(T0),
+                    output_sample_interval=1.0,
+                    edge_buffer=8.0,
+                    process_patch_size=40,
+                    poll_interval=0.0,
+                    file_duration=0.0,
+                    sleep_fn=boom_sleep,
+                )
+        got = read_health(out)
+        assert got is not None
+        assert got["last_error"] is not None
+
+
+class TestSatellites:
+    def test_log_event_drops_counted_and_warned(self, capsys):
+        from tpudas.utils import logging as tlog
+
+        reg = MetricsRegistry()
+
+        def bad_handler(event):
+            raise ValueError("broken pipe")
+
+        tlog.set_log_handler(bad_handler)
+        drops0 = tlog.event_drops()
+        try:
+            with use_registry(reg):
+                tlog.log_event("round_done", n=1)
+                tlog.log_event("round_done", n=2)
+        finally:
+            tlog.set_log_handler(None)
+        assert tlog.event_drops() == drops0 + 2
+        assert reg.value("tpudas_log_event_drops_total") == 2
+        # the one-time stderr warning (process-lifetime latch: only
+        # assert it names the counter if it fired in THIS test run)
+        err = capsys.readouterr().err
+        if err:
+            assert "tpudas_log_event_drops_total" in err
+
+    def test_device_trace_env_logdir(self, tmp_path, monkeypatch):
+        from tpudas.utils.profiling import device_trace
+
+        monkeypatch.delenv("TPUDAS_TRACE_DIR", raising=False)
+        with pytest.raises(ValueError):
+            with device_trace():
+                pass
+        monkeypatch.setenv("TPUDAS_TRACE_DIR", str(tmp_path / "tr"))
+        ran = []
+        with device_trace():
+            ran.append(True)  # block runs whatever the backend does
+        assert ran == [True]
+
+    def test_counters_measure_mirrors_registry(self):
+        from tpudas.utils.profiling import Counters
+
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            ctr = Counters()
+            with ctr.measure(500, 5.0):
+                pass
+        assert reg.value("tpudas_proc_channel_samples_total") == 500
+        assert reg.value("tpudas_proc_data_seconds_total") == 5.0
+        assert reg.value("tpudas_proc_wall_seconds_total") > 0

@@ -1,0 +1,77 @@
+"""tools/check_metrics.py wired into tier-1: the metric/span-name
+catalog in OBSERVABILITY.md can never drift from the code."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import check_metrics  # noqa: E402
+
+
+def test_repo_is_clean():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "check_metrics.py")],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "check_metrics: OK" in proc.stdout
+
+
+def test_collect_names_matches_call_styles():
+    src = (
+        'reg.counter("tpudas_a_total", "h").inc()\n'
+        "reg.histogram(\n"
+        '    "tpudas_b_seconds",\n'
+        '    "h",\n'
+        ").observe(1)\n"
+        "with span(\n"
+        '    "stream.round", mode="x"\n'
+        "):\n"
+        "    pass\n"
+    )
+    metrics, spans = check_metrics.collect_names(src)
+    assert ("counter", "tpudas_a_total") in metrics
+    assert ("histogram", "tpudas_b_seconds") in metrics
+    assert spans == ["stream.round"]
+
+
+@pytest.mark.parametrize(
+    "bad", ["Tpudas_x_total", "tpudas_X", "other_total", "tpudas-x"]
+)
+def test_lint_flags_bad_names(bad):
+    problems = check_metrics.lint(
+        {"f.py": f'reg.counter("{bad}", "h").inc()'},
+        catalog_text=f"`{bad}`",
+    )
+    assert problems and "does not match" in problems[0]
+
+
+def test_lint_flags_uncatalogued():
+    problems = check_metrics.lint(
+        {"f.py": 'reg.gauge("tpudas_mystery_gauge").set(1)'},
+        catalog_text="# empty catalog",
+    )
+    assert problems and "not catalogued" in problems[0]
+    # catalogued -> clean
+    assert (
+        check_metrics.lint(
+            {"f.py": 'reg.gauge("tpudas_mystery_gauge").set(1)'},
+            catalog_text="| `tpudas_mystery_gauge` | gauge |",
+        )
+        == []
+    )
+
+
+def test_lint_flags_uncatalogued_span():
+    problems = check_metrics.lint(
+        {"f.py": 'with span("secret.phase"):\n    pass'},
+        catalog_text="# empty catalog",
+    )
+    assert problems and "span name" in problems[0]

@@ -1,0 +1,116 @@
+"""Metric-name lint: every registry metric is well-named and catalogued.
+
+Scans the instrumented sources (``tpudas/``, ``tools/``, ``bench.py``)
+for literal metric names passed to ``.counter(...)`` / ``.gauge(...)``
+/ ``.histogram(...)`` and (a) validates each against the naming
+convention ``tpudas_[a-z0-9_]+``, (b) requires each to appear in the
+``OBSERVABILITY.md`` catalog — so the catalog can never silently rot
+behind the code.  Literal span names are checked against the catalog
+too (section "Span names").
+
+Run from anywhere:
+
+    python tools/check_metrics.py
+
+Exit code 0 = clean; 1 = violations (printed one per line).  Wired
+into tier-1 via tests/test_obs_lint.py.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+NAME_RE = re.compile(r"^tpudas_[a-z0-9_]+$")
+# literal first argument of .counter( / .gauge( / .histogram(
+METRIC_CALL_RE = re.compile(
+    r"\.(counter|gauge|histogram)\(\s*[\r\n]*\s*['\"]([^'\"]+)['\"]"
+)
+SPAN_CALL_RE = re.compile(r"(?<!\w)span\(\s*['\"]([^'\"]+)['\"]")
+
+SCAN_ROOTS = ("tpudas", "tools")
+SCAN_FILES = ("bench.py",)
+CATALOG = "OBSERVABILITY.md"
+
+
+def iter_source_files(repo: str = REPO):
+    for root_name in SCAN_ROOTS:
+        for dirpath, _dirnames, filenames in os.walk(
+            os.path.join(repo, root_name)
+        ):
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+    for fn in SCAN_FILES:
+        path = os.path.join(repo, fn)
+        if os.path.isfile(path):
+            yield path
+
+
+def collect_names(text: str):
+    """(metric_names, span_names) literal uses in one source text."""
+    metrics = [(m.group(1), m.group(2)) for m in METRIC_CALL_RE.finditer(text)]
+    spans = [m.group(1) for m in SPAN_CALL_RE.finditer(text)]
+    return metrics, spans
+
+
+def lint(sources: dict, catalog_text: str):
+    """``sources``: {path: text}.  Returns a list of violation
+    strings (empty = clean)."""
+    problems = []
+    seen_metrics = set()
+    seen_spans = set()
+    for path, text in sorted(sources.items()):
+        metrics, spans = collect_names(text)
+        for kind, name in metrics:
+            if not NAME_RE.match(name):
+                problems.append(
+                    f"{path}: {kind} name {name!r} does not match "
+                    f"{NAME_RE.pattern}"
+                )
+            seen_metrics.add(name)
+        seen_spans.update(spans)
+    for name in sorted(seen_metrics):
+        if f"`{name}`" not in catalog_text:
+            problems.append(
+                f"metric {name!r} is not catalogued in {CATALOG} "
+                "(add a `name` row to the metric catalog)"
+            )
+    for name in sorted(seen_spans):
+        if f"`{name}`" not in catalog_text:
+            problems.append(
+                f"span name {name!r} is not catalogued in {CATALOG} "
+                "(add it to the span-name table)"
+            )
+    return problems
+
+
+def main(argv=None) -> int:
+    repo = (argv or [None, REPO])[1] if argv and len(argv) > 1 else REPO
+    catalog_path = os.path.join(repo, CATALOG)
+    if not os.path.isfile(catalog_path):
+        print(f"missing {CATALOG} at {catalog_path}")
+        return 1
+    with open(catalog_path) as fh:
+        catalog_text = fh.read()
+    sources = {}
+    for path in iter_source_files(repo):
+        with open(path) as fh:
+            sources[os.path.relpath(path, repo)] = fh.read()
+    problems = lint(sources, catalog_text)
+    for p in problems:
+        print(p)
+    if not problems:
+        n = len(
+            {m for _, t in sources.items() for m in
+             (name for _k, name in collect_names(t)[0])}
+        )
+        print(f"check_metrics: OK ({n} metric names catalogued)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

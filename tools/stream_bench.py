@@ -19,7 +19,17 @@ state — and reports the structural win the stateful mode claims:
 - ``outputs_match``: max relative difference between the two modes'
   outputs over their common interior (the rewind mode is the oracle).
 
-Writes one JSON artifact (default ``BENCH_pr01.json`` at the repo
+Since ISSUE 2 the per-mode headline numbers are read from the
+tpudas.obs metrics registry (each drive runs under a fresh registry
+via ``use_registry``; see ``tpudas.obs.registry.headline``) rather
+than ad-hoc locals, so BENCH_*.json and a run's ``metrics.prom`` can
+never disagree.  The report also measures the observability overhead:
+an extra stateful drive with ``TPUDAS_OBS=0`` (instrumentation
+no-oped, health off) vs one with full instrumentation +
+``TPUDAS_HEALTH=1``; ``obs_overhead.overhead_pct`` is the steady-state
+round-time cost (acceptance: < 2%).
+
+Writes one JSON artifact (default ``BENCH_pr02.json`` at the repo
 root) and prints it.  Pure CPU — no TPU tunnel, no subprocess dance —
 so CI can run it anywhere:
 
@@ -54,9 +64,18 @@ EDGE_SEC = 40.0
 PATCH_OUT = 100
 
 
-def _drive(src, out, rounds, files_per_round, stateful, feed):
-    """One realtime run: ``feed(round_index)`` appends that round's
-    files before each poll.  Returns the per-round metrics."""
+def _drive(src, out, rounds, files_per_round, stateful, feed,
+           health=False):
+    """One realtime run under a FRESH obs registry: ``feed(round_index)``
+    appends that round's files before each poll.  Returns the per-round
+    metrics; the headline counters come from the registry
+    (tpudas.obs.registry.headline), not ad-hoc locals."""
+    from tpudas.obs.registry import (
+        MetricsRegistry,
+        headline,
+        obs_enabled,
+        use_registry,
+    )
     from tpudas.proc.streaming import run_lowpass_realtime
     from tpudas.utils.logging import set_log_handler
     from tpudas.utils.profiling import Counters
@@ -75,21 +94,31 @@ def _drive(src, out, rounds, files_per_round, stateful, feed):
             state["fed"] += 1
             feed(state["fed"])
 
+    # an explicit use_registry scope overrides TPUDAS_OBS=0 (benches
+    # that install a registry want numbers), so the obs_off overhead
+    # baseline must NOT install one — the kill-switch then no-ops the
+    # instrumentation end to end
+    import contextlib
+
+    reg = MetricsRegistry()
+    scope = use_registry(reg) if obs_enabled() else contextlib.nullcontext()
     try:
-        n_rounds = run_lowpass_realtime(
-            source=src,
-            output_folder=out,
-            start_time="2023-03-22T00:00:00",
-            output_sample_interval=DT_OUT,
-            edge_buffer=EDGE_SEC,
-            process_patch_size=PATCH_OUT,
-            poll_interval=0.0,
-            file_duration=0.0,
-            sleep_fn=fake_sleep,
-            max_rounds=rounds + 2,
-            counters=counters,
-            stateful=stateful,
-        )
+        with scope:
+            n_rounds = run_lowpass_realtime(
+                source=src,
+                output_folder=out,
+                start_time="2023-03-22T00:00:00",
+                output_sample_interval=DT_OUT,
+                edge_buffer=EDGE_SEC,
+                process_patch_size=PATCH_OUT,
+                poll_interval=0.0,
+                file_duration=0.0,
+                sleep_fn=fake_sleep,
+                max_rounds=rounds + 2,
+                counters=counters,
+                stateful=stateful,
+                health=health,
+            )
     finally:
         set_log_handler(None)
     if state["first_out"] is None and any(
@@ -99,16 +128,34 @@ def _drive(src, out, rounds, files_per_round, stateful, feed):
     per_round = [
         e for e in events if e["event"] == "realtime_round"
     ]
+    # headline numbers from the registry the run just filled; under
+    # TPUDAS_OBS=0 (the overhead baseline) the registry is no-oped, so
+    # fall back to the per-run Counters accumulator
+    h = headline(reg)
+    if not obs_enabled():
+        h = {
+            "channel_samples": counters.channel_samples,
+            "samples_redundant": counters.samples_redundant,
+            "redundant_ratio": counters.redundant_ratio,
+            "realtime_factor": counters.realtime_factor,
+        }
+    span_hist = reg.get("tpudas_span_seconds")
+    span_count = (
+        sum(s[1]["count"] for s in reg.snapshot()["tpudas_span_seconds"]["series"])
+        if span_hist is not None
+        else 0
+    )
     return {
         "rounds": n_rounds,
         "mode": per_round[-1]["mode"] if per_round else None,
+        "obs_span_count": span_count,
         "data_seconds": [e["data_seconds"] for e in per_round],
         "wall_seconds": [e["wall_seconds"] for e in per_round],
         "counters": {
-            "channel_samples": counters.channel_samples,
-            "samples_redundant": counters.samples_redundant,
-            "redundant_ratio": round(counters.redundant_ratio, 4),
-            "realtime_factor": round(counters.realtime_factor, 2),
+            "channel_samples": int(h["channel_samples"]),
+            "samples_redundant": int(h["samples_redundant"]),
+            "redundant_ratio": round(h["redundant_ratio"], 4),
+            "realtime_factor": round(h["realtime_factor"], 2),
         },
         "first_output_latency_s": (
             None
@@ -116,6 +163,80 @@ def _drive(src, out, rounds, files_per_round, stateful, feed):
             else round(state["first_out"], 3)
         ),
     }
+
+
+def _instr_cost_per_round(spans_per_round, reg_ops_per_round, folder):
+    """Directly measured deterministic cost of one steady round's
+    instrumentation, as ``(in_round_s, health_s)``:
+
+    - ``in_round_s`` replays what executes INSIDE the measured round —
+      nested spans (with a live log handler, as the drive runs) and
+      registry counter/gauge/histogram updates;
+    - ``health_s`` is the per-round health.json + metrics.prom write,
+      which the driver performs AFTER the measured round, in the
+      inter-round idle (production rounds are separated by a >= 125 s
+      poll sleep, so it never delays processing).
+
+    Whole-drive A/B cannot resolve a percent-level effect under
+    shared-CPU scheduler noise; the bundle replay measures exactly the
+    added instructions."""
+    from tpudas.obs.health import write_health, write_prom
+    from tpudas.obs.registry import (
+        MetricsRegistry,
+        get_registry,
+        use_registry,
+    )
+    from tpudas.obs.trace import span
+    from tpudas.utils.logging import set_log_handler
+
+    payload = {
+        "rounds": 1, "polls": 1, "mode": "stateful",
+        "realtime_factor": 100.0, "round_realtime_factor": 100.0,
+        "head_lag_seconds": 10.0, "redundant_ratio": 0.0,
+        "carry_resume_count": 0, "last_round_wall_seconds": 0.05,
+        "last_error": None,
+    }
+    os.makedirs(folder, exist_ok=True)
+    sink = []
+    reg = MetricsRegistry()
+    n = 200
+    set_log_handler(sink.append)
+    try:
+        with use_registry(reg):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                with span("stream.round", mode="stateful", round=1):
+                    with span("stream.increment", upto="t"):
+                        for _ in range(max(1, spans_per_round - 2)):
+                            with span(
+                                "op.cascade_stream", rows=3200,
+                                engine="auto",
+                            ):
+                                pass
+                        for _ in range(reg_ops_per_round // 3 + 1):
+                            # resolve get_registry() per op, exactly
+                            # as real instrumentation sites do (the
+                            # env lookup is part of the cost)
+                            get_registry().counter(
+                                "tpudas_stream_blocks_total",
+                                labelnames=("engine",),
+                            ).inc(engine="cascade-xla")
+                            get_registry().histogram(
+                                "tpudas_stream_block_seconds",
+                                labelnames=("engine",),
+                            ).observe(0.01, engine="cascade-xla")
+                            get_registry().gauge(
+                                "tpudas_stream_realtime_factor"
+                            ).set(100.0)
+            in_round = (time.perf_counter() - t0) / n
+            t0 = time.perf_counter()
+            for _ in range(n):
+                write_health(folder, dict(payload))
+                write_prom(folder)
+            health = (time.perf_counter() - t0) / n
+    finally:
+        set_log_handler(None)
+    return in_round, health
 
 
 def _merged(out):
@@ -213,6 +334,101 @@ def run(out_path, rounds=4, files_per_round=2):
         bv = b.select(time=(lo, hi)).host_data()
         rel = float(np.abs(av - bv).max() / np.abs(bv).max())
 
+        # instrumentation overhead: the same stateful drive with the
+        # obs kill-switch on (TPUDAS_OBS=0, health off) vs fully
+        # instrumented + per-round health.json/metrics.prom writes.
+        # A steady round is tens of ms on shared CPU, where scheduler
+        # noise dwarfs the instrumentation, so estimate the
+        # DETERMINISTIC cost floor: the MIN steady-state round over
+        # several interleaved repetitions per mode (noise only ever
+        # inflates a round; the floor is the honest per-round cost).
+        ov_rounds = max(rounds, 8)
+        ov_reps = 3
+        obs_walls = {"obs_off": [], "obs_on": []}
+        for rep in range(ov_reps):
+            for tag, env_val, health in (
+                ("obs_off", "0", False),
+                ("obs_on", "1", True),
+            ):
+                key = f"{tag}{rep}"
+                src = os.path.join(td, f"src_{key}")
+                make_synthetic_spool(
+                    src, n_files=n_init, file_duration=FILE_SEC, fs=FS,
+                    n_ch=N_CH, noise=0.01,
+                )
+                srcs[key] = src
+                prev = os.environ.get("TPUDAS_OBS")
+                os.environ["TPUDAS_OBS"] = env_val
+                try:
+                    r = _drive(
+                        src, os.path.join(td, f"out_{key}"), ov_rounds,
+                        files_per_round, True, feeder(key),
+                        health=health,
+                    )
+                finally:
+                    if prev is None:
+                        os.environ.pop("TPUDAS_OBS", None)
+                    else:
+                        os.environ["TPUDAS_OBS"] = prev
+                walls = r["wall_seconds"][1:]  # steady: skip backlog
+                if walls:
+                    obs_walls[tag].append(min(walls))
+                if tag == "obs_on":
+                    last_on = r
+        floor = {k: min(v) if v else 0.0 for k, v in obs_walls.items()}
+        # per-round instrumentation volume observed by the last
+        # instrumented drive, overcounted 2x for safety
+        spans_pr = 2 * max(
+            1,
+            int(
+                last_on["obs_span_count"]
+                / max(last_on["rounds"], 1)
+            ),
+        )
+        in_round_s, health_s = _instr_cost_per_round(
+            spans_pr, 3 * spans_pr, os.path.join(td, "instr_bundle")
+        )
+        obs_overhead = {
+            "steady_round_wall_s": {
+                k: round(v, 5) for k, v in floor.items()
+            },
+            "rounds": ov_rounds,
+            "reps": ov_reps,
+            "ab_floor_delta_pct": (
+                round(
+                    100.0 * (floor["obs_on"] - floor["obs_off"])
+                    / floor["obs_off"],
+                    2,
+                )
+                if floor.get("obs_off")
+                else None
+            ),
+            # the acceptance number: deterministic replay of the
+            # IN-ROUND instrumentation (2x overcounted span/registry
+            # volume) as a fraction of the uninstrumented steady
+            # round — whole-drive A/B (ab_floor_delta_pct) is
+            # noise-bound on shared CPU.  The health.json/metrics.prom
+            # write runs AFTER the measured round in the inter-round
+            # idle (>= 125 s poll sleep in production) and is reported
+            # separately.
+            "in_round_instr_s": round(in_round_s, 6),
+            "health_write_s_off_path": round(health_s, 6),
+            "spans_per_round_replayed": spans_pr,
+            "overhead_pct": (
+                round(100.0 * in_round_s / floor["obs_off"], 2)
+                if floor.get("obs_off")
+                else None
+            ),
+            "note": (
+                "ab_floor_delta_pct swings +-8% (incl. negative) "
+                "across runs on this shared CPU — a ~40 ms round "
+                "cannot resolve a sub-ms effect; overhead_pct is the "
+                "deterministic bundle replay (2x-overcounted op "
+                "volume, get_registry() resolved per op like real "
+                "sites)"
+            ),
+        }
+
     # steady-state per-round workload: skip round 1 (both modes chew
     # the identical initial backlog there)
     def steady(d):
@@ -273,6 +489,8 @@ def run(out_path, rounds=4, files_per_round=2):
         "head_lag_s": {m: results[m]["head_lag_s"] for m in results},
         "outputs_match_rel_err": round(rel, 8),
         "outputs_match": rel < 1e-4,
+        "headline_source": "tpudas.obs.registry",
+        "obs_overhead": obs_overhead,
         "modes": results,
         "bench_wall_s": round(time.perf_counter() - t_bench0, 2),
     }
@@ -286,7 +504,7 @@ def run(out_path, rounds=4, files_per_round=2):
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument(
-        "--out", default=os.path.join(REPO, "BENCH_pr01.json")
+        "--out", default=os.path.join(REPO, "BENCH_pr02.json")
     )
     ap.add_argument("--rounds", type=int, default=4)
     ap.add_argument("--files-per-round", type=int, default=2)

@@ -18,12 +18,14 @@ from tpudas.core.timeutils import to_datetime64, to_timedelta64
 from tpudas.core.mapping import FrozenDict
 from tpudas.io.spool import spool, BaseSpool, MemorySpool, DirectorySpool
 from tpudas.core import units
+from tpudas import obs
 
-__version__ = "0.5.0"
+__version__ = "0.6.0"
 
 __all__ = [
     "Patch",
     "spool",
+    "obs",
     "BaseSpool",
     "MemorySpool",
     "DirectorySpool",

@@ -12,6 +12,7 @@ touches only the overlapping files and only the needed byte ranges.
 from __future__ import annotations
 
 import os
+import time as _time
 
 import numpy as np
 import pandas as pd
@@ -19,6 +20,7 @@ import pandas as pd
 from tpudas.core.patch import Patch
 from tpudas.core.timeutils import to_datetime64
 from tpudas.io.index import DirectoryIndex
+from tpudas.obs.registry import get_registry
 from tpudas.utils.logging import log_event
 
 __all__ = ["spool", "BaseSpool", "MemorySpool", "DirectorySpool", "merge_patches"]
@@ -381,7 +383,16 @@ class DirectorySpool(BaseSpool):
 
     def update(self):
         """Re-scan the directory for new/changed files (incremental)."""
+        reg = get_registry()
+        t0 = _time.perf_counter()
         self._index.update()
+        reg.histogram(
+            "tpudas_spool_update_seconds",
+            "directory index re-scan latency",
+        ).observe(_time.perf_counter() - t0)
+        reg.counter(
+            "tpudas_spool_updates_total", "directory index re-scans"
+        ).inc()
         return self._clone()
 
     def sort(self, key="time"):
@@ -421,12 +432,21 @@ class DirectorySpool(BaseSpool):
     def _read_row(self, row) -> Patch:
         from tpudas.io.registry import read_file
 
+        reg = get_registry()
+        t0 = _time.perf_counter()
         patches = read_file(
             row["path"],
             format=row.get("format", "dasdae"),
             time=self._time,
             distance=self._distance,
         )
+        reg.histogram(
+            "tpudas_spool_read_seconds",
+            "per-file payload read latency (selection applied)",
+        ).observe(_time.perf_counter() - t0)
+        reg.counter(
+            "tpudas_spool_reads_total", "file payload reads"
+        ).inc()
         return patches[0]
 
     def _materialize(self):

@@ -1,0 +1,50 @@
+"""tpudas.obs — run introspection for the streaming stack.
+
+Three pieces (ISSUE 2; FiLark argues a streaming-first DAS framework
+needs first-class run introspection):
+
+- :mod:`tpudas.obs.registry` — process-wide metrics registry
+  (counters / gauges / histograms with labels, thread-safe, zero-dep)
+  with Prometheus text exposition;
+- :mod:`tpudas.obs.trace` — ``span("name", **attrs)`` nested timed
+  spans into a bounded ring buffer, JSONL export via ``log_event`` and
+  optional ``jax.profiler.TraceAnnotation`` pass-through;
+- :mod:`tpudas.obs.health` — atomic ``health.json`` +
+  ``metrics.prom`` snapshots the realtime driver drops beside the
+  stream carry (``TPUDAS_HEALTH=1``) for out-of-process scraping.
+
+Metric catalog and conventions: ``OBSERVABILITY.md`` (linted by
+``tools/check_metrics.py``).  Kill-switch: ``TPUDAS_OBS=0``.
+"""
+
+from tpudas.obs.health import (
+    HEALTH_FILENAME,
+    HEALTH_SCHEMA_VERSION,
+    PROM_FILENAME,
+    read_health,
+    write_health,
+    write_prom,
+)
+from tpudas.obs.registry import (
+    MetricsRegistry,
+    get_registry,
+    headline,
+    use_registry,
+)
+from tpudas.obs.trace import clear_spans, get_spans, span
+
+__all__ = [
+    "MetricsRegistry",
+    "get_registry",
+    "use_registry",
+    "headline",
+    "span",
+    "get_spans",
+    "clear_spans",
+    "write_health",
+    "read_health",
+    "write_prom",
+    "HEALTH_FILENAME",
+    "PROM_FILENAME",
+    "HEALTH_SCHEMA_VERSION",
+]

@@ -1,0 +1,157 @@
+"""Edge health snapshot: ``health.json`` + ``metrics.prom`` on disk.
+
+The paper's deployment target is an unattended box at the
+interrogator; an operator (or a cron/node-exporter textfile collector)
+must be able to tell from OUTSIDE the process whether the stream is
+keeping up.  The realtime driver writes two files beside the stream
+carry every round:
+
+- ``health.json`` — one small JSON object (schema below) with the
+  liveness numbers: realtime_factor, head-lag seconds behind the fiber
+  head, rounds, redundant ratio, carry-resume count, last error;
+- ``metrics.prom`` — the full registry in Prometheus text exposition
+  format, ready for the node-exporter textfile collector.
+
+Both writes are atomic (tmp + ``os.replace``), and ``health.json`` is
+double-buffered: the previous good snapshot survives as
+``health.json.prev``, and :func:`read_health` falls back to it when
+the primary is torn/corrupt (e.g. an operator copying the file
+mid-rename on a non-atomic network mount).  A health write must never
+crash the processing loop — failures are counted
+(``tpudas_health_write_errors_total``) and swallowed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from tpudas.obs.registry import get_registry
+
+__all__ = [
+    "HEALTH_FILENAME",
+    "PROM_FILENAME",
+    "HEALTH_SCHEMA_VERSION",
+    "HEALTH_REQUIRED_KEYS",
+    "write_health",
+    "read_health",
+    "write_prom",
+    "validate_health",
+]
+
+HEALTH_FILENAME = "health.json"
+PROM_FILENAME = "metrics.prom"
+HEALTH_SCHEMA_VERSION = 1
+
+# keys every snapshot carries (OBSERVABILITY.md documents types/units);
+# tests schema-check against this
+HEALTH_REQUIRED_KEYS = (
+    "schema",
+    "written_at",
+    "rounds",
+    "polls",
+    "mode",
+    "realtime_factor",
+    "round_realtime_factor",
+    "head_lag_seconds",
+    "redundant_ratio",
+    "carry_resume_count",
+    "last_round_wall_seconds",
+    "last_error",
+)
+
+
+def _atomic_write_text(path: str, text: str) -> None:
+    """tmp + rename: readers never see a partial file.  Deliberately
+    no fsync — durability across power loss is not worth milliseconds
+    per round for a snapshot that is rewritten every round; the .prev
+    double-buffer covers the corrupt-primary case."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write(text)
+    os.replace(tmp, path)
+
+
+def validate_health(payload: dict) -> dict:
+    """Raise ``ValueError`` unless ``payload`` carries every required
+    key and a known schema version; returns the payload."""
+    missing = [k for k in HEALTH_REQUIRED_KEYS if k not in payload]
+    if missing:
+        raise ValueError(f"health payload missing keys: {missing}")
+    if payload["schema"] != HEALTH_SCHEMA_VERSION:
+        raise ValueError(
+            f"unknown health schema {payload['schema']!r} "
+            f"(expected {HEALTH_SCHEMA_VERSION})"
+        )
+    return payload
+
+
+def write_health(folder: str, payload: dict) -> str | None:
+    """Atomically write ``health.json`` in ``folder`` (previous good
+    snapshot preserved as ``health.json.prev``).  Returns the path, or
+    None when the write failed (counted, never raised — the health
+    writer must not take down the stream it reports on)."""
+    payload = dict(payload)
+    payload.setdefault("schema", HEALTH_SCHEMA_VERSION)
+    payload.setdefault("written_at", time.time())
+    reg = get_registry()
+    path = os.path.join(folder, HEALTH_FILENAME)
+    try:
+        validate_health(payload)
+        # rename (not copy) the outgoing primary to .prev: a rename is
+        # ~10x cheaper than a copy on overlay filesystems, and the
+        # microsecond window with no primary is exactly the case
+        # read_health's .prev fallback already covers
+        if os.path.isfile(path):
+            os.replace(path, path + ".prev")
+        _atomic_write_text(path, json.dumps(payload, indent=1) + "\n")
+    except Exception as exc:
+        reg.counter(
+            "tpudas_health_write_errors_total",
+            "failed health.json/metrics.prom writes (swallowed)",
+        ).inc()
+        from tpudas.utils.logging import log_event
+
+        log_event("health_write_failed", error=str(exc)[:200])
+        return None
+    reg.counter(
+        "tpudas_health_writes_total", "health.json snapshots written"
+    ).inc()
+    return path
+
+
+def read_health(folder: str) -> dict | None:
+    """The last GOOD health snapshot: ``health.json``, falling back to
+    ``health.json.prev`` when the primary is torn/corrupt/absent; None
+    when neither parses."""
+    base = os.path.join(folder, HEALTH_FILENAME)
+    for path in (base, base + ".prev"):
+        try:
+            with open(path) as fh:
+                payload = json.load(fh)
+            return validate_health(payload)
+        except Exception:
+            continue
+    return None
+
+
+def write_prom(folder: str, registry=None) -> str | None:
+    """Atomically write the registry's Prometheus exposition as
+    ``metrics.prom`` in ``folder`` (node-exporter textfile collector
+    format).  Returns the path, or None on (counted, swallowed)
+    failure."""
+    reg = registry if registry is not None else get_registry()
+    path = os.path.join(folder, PROM_FILENAME)
+    try:
+        _atomic_write_text(path, reg.to_prometheus())
+    except Exception as exc:
+        get_registry().counter(
+            "tpudas_health_write_errors_total",
+            "failed health.json/metrics.prom writes (swallowed)",
+        ).inc()
+        from tpudas.utils.logging import log_event
+
+        log_event("health_write_failed", error=str(exc)[:200])
+        return None
+    return path

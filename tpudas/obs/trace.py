@@ -1,0 +1,205 @@
+"""Span tracing: nested timed spans into a bounded ring buffer.
+
+``with span("stream.round", round=3): ...`` records a wall-clock span
+with attributes; spans nest per-thread (each span knows its parent and
+depth), land in a process-wide ring buffer (bounded — the edge box
+must never grow memory with uptime), feed the
+``tpudas_span_seconds{name=...}`` histogram, and export one
+``log_event("span", ...)`` line each through the existing JSONL
+pipeline (skipped wholesale when no log handler is installed, so the
+default cost is one perf_counter pair, a ring append, and one
+histogram update — a hand-rolled context manager, not
+``@contextmanager``, keeps that under ~10 us on the stream hot path).
+
+``TPUDAS_TRACE_ANNOTATE=1`` additionally wraps each span in
+``jax.profiler.TraceAnnotation`` so spans line up with
+``device_trace`` / ``TPUDAS_TRACE_DIR`` TensorBoard output.
+
+``TPUDAS_OBS=0`` disables recording entirely (same kill-switch as the
+registry); ``TPUDAS_SPAN_RING`` sizes the ring (default 2048 finished
+spans).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+from tpudas.obs import registry as _registry_mod
+from tpudas.utils import logging as _logging
+
+__all__ = [
+    "span",
+    "get_spans",
+    "clear_spans",
+    "span_ring_capacity",
+]
+
+_DEFAULT_RING = 2048
+
+
+def span_ring_capacity() -> int:
+    try:
+        cap = int(os.environ.get("TPUDAS_SPAN_RING", _DEFAULT_RING))
+    except ValueError:
+        cap = _DEFAULT_RING
+    return max(1, cap)
+
+
+_lock = threading.Lock()
+_ring: deque = deque(maxlen=span_ring_capacity())
+_local = threading.local()
+_next_id = 0
+# jax.profiler.TraceAnnotation resolved once (None = unresolved,
+# False = unavailable/disabled) — the old device_trace re-imported jax
+# on every call; spans must not repeat that on the hot path
+_annotation_cls = None
+
+
+def _span_stack():
+    st = getattr(_local, "stack", None)
+    if st is None:
+        st = _local.stack = []
+    return st
+
+
+def _trace_annotation():
+    global _annotation_cls
+    if _annotation_cls is None:
+        if os.environ.get("TPUDAS_TRACE_ANNOTATE", "0") != "1":
+            _annotation_cls = False
+        else:
+            try:
+                import jax
+
+                _annotation_cls = jax.profiler.TraceAnnotation
+            except Exception:  # pragma: no cover - backend specific
+                _annotation_cls = False
+    return _annotation_cls
+
+
+def _span_metrics(reg):
+    """(histogram, eviction_counter) handles, memoized on the registry
+    instance — the per-span cost must not include get-or-create."""
+    handles = getattr(reg, "_span_metric_handles", None)
+    if handles is None:
+        handles = (
+            reg.histogram(
+                "tpudas_span_seconds",
+                "span wall-clock duration by span name",
+                labelnames=("name",),
+            ),
+            reg.counter(
+                "tpudas_spans_evicted_total",
+                "finished spans dropped from the full ring buffer",
+            ),
+        )
+        try:
+            reg._span_metric_handles = handles
+        except AttributeError:  # pragma: no cover - exotic registry
+            pass
+    return handles
+
+
+class _Span:
+    """Hand-rolled context manager (no ``@contextmanager`` generator
+    machinery) for the hot path.  Yields the mutable span record."""
+
+    __slots__ = ("name", "attrs", "rec", "_cm", "_t0", "_reg")
+
+    def __init__(self, name, attrs):
+        self.name = name
+        self.attrs = attrs
+        self.rec = None
+
+    def __enter__(self):
+        # same gate as the registry: TPUDAS_OBS=0 disables spans
+        # unless an explicit use_registry scope asked for measurements
+        reg = _registry_mod.get_registry()
+        if reg is _registry_mod._NOOP_REGISTRY:
+            return None
+        global _next_id
+        stack = _span_stack()
+        parent = stack[-1] if stack else None
+        with _lock:
+            _next_id += 1
+            sid = _next_id
+        rec = self.rec = {
+            "name": str(self.name),
+            "id": sid,
+            "parent": None if parent is None else parent["id"],
+            "depth": len(stack),
+            "attrs": self.attrs,
+        }
+        stack.append(rec)
+        self._reg = reg
+        ann = _trace_annotation()
+        self._cm = ann(rec["name"]) if ann else None
+        if self._cm is not None:
+            self._cm.__enter__()
+        rec["start"] = time.time()
+        self._t0 = time.perf_counter()
+        return rec
+
+    def __exit__(self, exc_type, exc, tb):
+        rec = self.rec
+        if rec is None:
+            return False
+        dur = time.perf_counter() - self._t0
+        if self._cm is not None:
+            self._cm.__exit__(None, None, None)
+        if exc is not None:
+            rec["error"] = repr(exc)[:200]
+        rec["duration_s"] = dur
+        _span_stack().pop()
+        with _lock:
+            evicted = len(_ring) == _ring.maxlen
+            _ring.append(rec)
+        hist, evictions = _span_metrics(self._reg)
+        if evicted:
+            evictions.inc()
+        hist.observe(dur, name=rec["name"])
+        # JSONL export through the existing pipeline (skipped wholesale
+        # when no handler is installed)
+        if _logging._handler is not None:
+            fields = {
+                **rec["attrs"],  # attrs first: the envelope keys win
+                "span": rec["name"],
+                "id": rec["id"],
+                "parent": rec["parent"],
+                "depth": rec["depth"],
+                "duration_s": round(dur, 6),
+            }
+            if "error" in rec:
+                fields["error"] = rec["error"]
+            _logging.log_event("span", **fields)
+        return False  # never swallow the body's exception
+
+
+def span(name: str, **attrs) -> _Span:
+    """Record a named, attributed, nested timed span around the block.
+
+    Exceptions propagate; the span is still recorded with
+    ``error=<repr prefix>`` so a crashed round leaves its trace."""
+    return _Span(name, attrs)
+
+
+def get_spans(name: str | None = None) -> list:
+    """Finished spans currently in the ring (oldest first), optionally
+    filtered by name.  Returns copies — callers cannot corrupt the
+    ring."""
+    with _lock:
+        recs = list(_ring)
+    if name is not None:
+        recs = [r for r in recs if r["name"] == name]
+    return [dict(r) for r in recs]
+
+
+def clear_spans() -> None:
+    """Empty the ring and re-read ``TPUDAS_SPAN_RING`` (tests resize
+    the ring this way)."""
+    global _ring
+    with _lock:
+        _ring = deque(maxlen=span_ring_capacity())

@@ -148,10 +148,13 @@ def fft_pass_filter_stream(block, carry, d_sec, low=None, high=None,
             f"block {tuple(block.shape)} does not match carry "
             f"{tuple(carry.shape)}"
         )
+    from tpudas.obs.trace import span
+
     edge = carry.shape[0] // 2
-    xc = jnp.concatenate([carry, block], axis=0)
-    filt = fft_pass_filter(xc, d_sec, low=low, high=high, order=order)
-    out = filt[edge : edge + block.shape[0]]
+    with span("op.fft_stream", rows=int(block.shape[0]), edge=int(edge)):
+        xc = jnp.concatenate([carry, block], axis=0)
+        filt = fft_pass_filter(xc, d_sec, low=low, high=high, order=order)
+        out = filt[edge : edge + block.shape[0]]
     return out, xc[xc.shape[0] - 2 * edge :]
 
 
@@ -195,21 +198,25 @@ def patch_pass_filter(patch, order=4, engine=None, **kwargs):
                 f"filter corner {edge} Hz outside (0, Nyquist={nyq}]"
             )
 
+    from tpudas.obs.trace import span
+
     data = patch.data
     moved = ax != 0
     if engine in ("numpy", "scipy", "host"):
-        host = np.asarray(data)
-        if moved:
-            host = np.moveaxis(host, ax, 0)
-        out = _host_sosfiltfilt(host, d, low, high, order)
-        out = out.astype(np.asarray(data).dtype, copy=False)
-        if moved:
-            out = np.moveaxis(out, 0, ax)
+        with span("op.pass_filter", engine="host"):
+            host = np.asarray(data)
+            if moved:
+                host = np.moveaxis(host, ax, 0)
+            out = _host_sosfiltfilt(host, d, low, high, order)
+            out = out.astype(np.asarray(data).dtype, copy=False)
+            if moved:
+                out = np.moveaxis(out, 0, ax)
     else:
-        arr = jnp.asarray(data)
-        if moved:
-            arr = jnp.moveaxis(arr, ax, 0)
-        out = fft_pass_filter(arr, d, low=low, high=high, order=order)
-        if moved:
-            out = jnp.moveaxis(out, 0, ax)
+        with span("op.pass_filter", engine="fft"):
+            arr = jnp.asarray(data)
+            if moved:
+                arr = jnp.moveaxis(arr, ax, 0)
+            out = fft_pass_filter(arr, d, low=low, high=high, order=order)
+            if moved:
+                out = jnp.moveaxis(out, 0, ax)
     return patch.new(data=out)

@@ -586,6 +586,8 @@ def cascade_decimate(
     """
     import jax.numpy as jnp
 
+    from tpudas.obs.trace import span
+
     engine = resolve_cascade_engine(engine)
     x = jnp.asarray(x)
     _check_quantized(x, qscale)
@@ -596,7 +598,10 @@ def cascade_decimate(
         fn = _build_cascade_fn(
             plan, int(n_out), engine, quantized=quantized
         )
-        return fn(*args)
+        # dispatch-side timing (async backends sync at the caller's
+        # np.asarray; the synced wall lands in window device metrics)
+        with span("op.cascade", rows=int(x.shape[0]), engine=engine):
+            return fn(*args)
     nc = mesh.shape[ch_axis]
     C = x2.shape[1]
     pad_c = -C % nc
@@ -784,8 +789,11 @@ def cascade_decimate_stream(x, carry, plan: CascadePlan, engine="auto"):
             "carry does not match this plan's stream_carry_sizes "
             f"({[int(np.shape(b)[0]) for b in carry]} vs {list(sizes)})"
         )
+    from tpudas.obs.trace import span
+
     fn = _build_stream_cascade_fn(plan, T, int(x.shape[1]), engine)
-    return fn(x, tuple(jnp.asarray(b, jnp.float32) for b in carry))
+    with span("op.cascade_stream", rows=T, engine=engine):
+        return fn(x, tuple(jnp.asarray(b, jnp.float32) for b in carry))
 
 
 # ---------------------------------------------------------------------------

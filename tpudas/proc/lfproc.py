@@ -44,6 +44,8 @@ from tpudas.core.timeutils import (
     to_datetime64,
 )
 from tpudas.io.spool import spool as make_spool
+from tpudas.obs.registry import get_registry
+from tpudas.obs.trace import span
 from tpudas.ops.resample import interp_indices_weights
 from tpudas.proc.naming import get_filename
 from tpudas.utils.logging import log_event
@@ -399,7 +401,29 @@ class LFProc:
             raise Exception("Please setup output folder first")
         from tpudas.proc.stream import process_increment
 
-        return process_increment(self, carry, edtime)
+        before = dict(self.timings)
+        try:
+            return process_increment(self, carry, edtime)
+        finally:
+            self._mirror_timings(before)
+
+    def _mirror_timings(self, before: dict) -> None:
+        """Mirror this run's phase-timing DELTAS (``self.timings`` is
+        cumulative per LFProc) into the obs registry — one call per
+        driver entry point keeps the per-window hot paths free of
+        registry traffic."""
+        reg = get_registry()
+        for key, metric, help_ in (
+            ("assemble_s", "tpudas_window_assemble_seconds_total",
+             "wall seconds waiting on window read + H2D staging"),
+            ("device_s", "tpudas_window_device_seconds_total",
+             "wall seconds in kernel dispatch through host sync"),
+            ("write_s", "tpudas_window_write_seconds_total",
+             "wall seconds writing HDF5 outputs"),
+        ):
+            delta = self.timings.get(key, 0.0) - before.get(key, 0.0)
+            if delta > 0:
+                reg.counter(metric, help_).inc(delta)
 
     # the engine -------------------------------------------------------
     def _load_window(self, t_lo, t_hi, on_gap):
@@ -425,27 +449,34 @@ class LFProc:
                     rows=plan["total_rows"],
                     payload=plan.get("payload", "float32"),
                 )
-                return assemble_window_patch(plan)
-        selected = self._spool.select(time=(t_lo, t_hi))
-        # data_gap_tolorance's single meaning (see
-        # _default_process_parameters): holes up to that many seconds
-        # are not gaps — the merge bridges them by linear interpolation
-        # (the native planner above already declined such windows, so
-        # gappy windows always take this path)
-        plist = make_spool(selected).chunk(
-            time=None,
-            max_fill=float(self._para["data_gap_tolorance"]),
-        )
-        if len(plist) == 0:
-            if on_gap == "raise":
-                raise Exception("patch merge failed! Gap in data exists")
-            return None
-        try:
-            return check_merge(plist)
-        except Exception:
-            if on_gap == "raise":
-                raise
-            return None
+                with span(
+                    "lfproc.load_window", native=True,
+                    files=len(plan["segments"]),
+                ):
+                    return assemble_window_patch(plan)
+        with span("lfproc.load_window", native=False):
+            selected = self._spool.select(time=(t_lo, t_hi))
+            # data_gap_tolorance's single meaning (see
+            # _default_process_parameters): holes up to that many
+            # seconds are not gaps — the merge bridges them by linear
+            # interpolation (the native planner above already declined
+            # such windows, so gappy windows always take this path)
+            plist = make_spool(selected).chunk(
+                time=None,
+                max_fill=float(self._para["data_gap_tolorance"]),
+            )
+            if len(plist) == 0:
+                if on_gap == "raise":
+                    raise Exception(
+                        "patch merge failed! Gap in data exists"
+                    )
+                return None
+            try:
+                return check_merge(plist)
+            except Exception:
+                if on_gap == "raise":
+                    raise
+                return None
 
     def _split_grid_at_gaps(self, time_grid):
         """[(g_lo, g_hi), ...] index ranges of ``time_grid`` covered by
@@ -538,8 +569,13 @@ class LFProc:
             trace_cm = device_trace(trace_dir)
         else:
             trace_cm = contextlib.nullcontext()
+        before = dict(self.timings)
         try:
-            with trace_cm:
+            with trace_cm, span(
+                "lfproc.process_time_range",
+                grid_points=len(time_grid),
+                segments=len(segments),
+            ):
                 total_windows = self._process_segments(
                     time_grid, segments, on_gap
                 )
@@ -549,6 +585,7 @@ class LFProc:
             # window-local origin)
             self._run_origin_ns = None
             self._first_window_of_run = True
+            self._mirror_timings(before)
         log_event(
             "process_time_range_done",
             windows=total_windows,
@@ -1289,6 +1326,11 @@ class LFProc:
         # ground truth of what ACTUALLY ran (post-execution: survives
         # the Pallas fallback above)
         self.engine_counts[ran] += 1
+        get_registry().counter(
+            "tpudas_windows_total",
+            "processed windows by the engine that actually ran",
+            labelnames=("engine",),
+        ).inc(engine=ran)
         log_event(
             "window_engine",
             engine=ran,

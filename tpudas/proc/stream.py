@@ -45,6 +45,8 @@ from dataclasses import dataclass
 import numpy as np
 
 from tpudas.core.timeutils import to_datetime64
+from tpudas.obs.registry import get_registry
+from tpudas.obs.trace import span
 from tpudas.utils.logging import log_event
 
 __all__ = [
@@ -135,20 +137,24 @@ def save_carry(carry: StreamCarry, folder: str) -> str:
     ``.npz`` (meta embedded, tmp-then-rename) plus a readable ``.json``
     sidecar.  Returns the npz path."""
     path = os.path.join(folder, CARRY_FILENAME)
-    arrays = {"meta": np.asarray(json.dumps(carry._meta()))}
-    for i, b in enumerate(carry.bufs):
-        arrays[f"buf_{i}"] = np.asarray(b, np.float32)
-    if carry.residual is not None:
-        arrays["residual"] = np.asarray(carry.residual, np.float32)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as fh:
-        np.savez(fh, **arrays)
-    os.replace(tmp, path)
-    side = os.path.join(folder, CARRY_SIDECAR)
-    tmp = side + ".tmp"
-    with open(tmp, "w") as fh:
-        json.dump(carry._meta(), fh, indent=1)
-    os.replace(tmp, side)
+    with span("stream.carry_save"):
+        arrays = {"meta": np.asarray(json.dumps(carry._meta()))}
+        for i, b in enumerate(carry.bufs):
+            arrays[f"buf_{i}"] = np.asarray(b, np.float32)
+        if carry.residual is not None:
+            arrays["residual"] = np.asarray(carry.residual, np.float32)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            np.savez(fh, **arrays)
+        os.replace(tmp, path)
+        side = os.path.join(folder, CARRY_SIDECAR)
+        tmp = side + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(carry._meta(), fh, indent=1)
+        os.replace(tmp, side)
+    get_registry().counter(
+        "tpudas_stream_carry_saves_total", "stream carry persists"
+    ).inc()
     return path
 
 
@@ -167,6 +173,10 @@ def discard_carry(folder: str) -> bool:
             removed = True
     if removed:
         log_event("stream_carry_discarded", folder=folder)
+        get_registry().counter(
+            "tpudas_stream_carry_discards_total",
+            "persisted carries invalidated by a non-stateful write",
+        ).inc()
     return removed
 
 
@@ -189,7 +199,14 @@ def load_carry(folder: str) -> StreamCarry | None:
             residual = f["residual"] if "residual" in f else None
     except Exception as exc:
         log_event("stream_carry_unreadable", error=str(exc)[:200])
+        get_registry().counter(
+            "tpudas_stream_carry_unreadable_total",
+            "corrupt/unreadable carries degraded to rewind mode",
+        ).inc()
         return None
+    get_registry().counter(
+        "tpudas_stream_carry_loads_total", "stream carries loaded"
+    ).inc()
     return StreamCarry(
         start_ns=meta["start_ns"],
         step_ns=meta["step_ns"],
@@ -241,6 +258,10 @@ def reconcile_outputs(folder: str, carry: StreamCarry) -> int:
                 removed += 1
     if removed:
         log_event("stream_reconcile_removed", files=removed)
+        get_registry().counter(
+            "tpudas_stream_reconcile_removed_total",
+            "crashed-round output files removed on carry resume",
+        ).inc(removed)
     return removed
 
 
@@ -331,45 +352,59 @@ def process_increment(lfp, carry: StreamCarry, edtime) -> int:
     )
     emitted0 = carry.emitted
     slice_ns = max(carry.patch_out, 4) * carry.step_ns
-    while True:
-        t_lo_ns = (
-            carry.next_ingest_ns
-            if carry.next_ingest_ns is not None
-            else carry.start_ns
-        )
-        if t_lo_ns > t2_ns:
-            break
-        t_hi_ns = min(t2_ns, t_lo_ns + slice_ns)
-        t_lo = np.datetime64(int(t_lo_ns), "ns")
-        t_hi = np.datetime64(int(t_hi_ns), "ns")
-        t0 = time.perf_counter()
-        patch = lfp._load_window(t_lo, t_hi, on_gap)
-        lfp.timings["assemble_s"] += time.perf_counter() - t0
-        if patch is None:
-            # unmergeable slice under a tolerant gap policy: skip it and
-            # cold-restart the engine at the next data (stream analogue
-            # of the batch path's skipped/split windows)
-            log_event(
-                "stream_gap_skipped", t_lo=str(t_lo), t_hi=str(t_hi)
+    reg = get_registry()
+    with span("stream.increment", upto=str(edtime)):
+        while True:
+            t_lo_ns = (
+                carry.next_ingest_ns
+                if carry.next_ingest_ns is not None
+                else carry.start_ns
             )
-            _reset_engine(carry)
-            carry.next_ingest_ns = t_hi_ns + 1
+            if t_lo_ns > t2_ns:
+                break
+            t_hi_ns = min(t2_ns, t_lo_ns + slice_ns)
+            t_lo = np.datetime64(int(t_lo_ns), "ns")
+            t_hi = np.datetime64(int(t_hi_ns), "ns")
+            t0 = time.perf_counter()
+            with span("stream.load_slice"):
+                patch = lfp._load_window(t_lo, t_hi, on_gap)
+            lfp.timings["assemble_s"] += time.perf_counter() - t0
+            if patch is None:
+                # unmergeable slice under a tolerant gap policy: skip
+                # it and cold-restart the engine at the next data
+                # (stream analogue of the batch path's skipped/split
+                # windows)
+                log_event(
+                    "stream_gap_skipped", t_lo=str(t_lo), t_hi=str(t_hi)
+                )
+                reg.counter(
+                    "tpudas_stream_gap_skips_total",
+                    "stream slices skipped over unmergeable gaps",
+                ).inc()
+                _reset_engine(carry)
+                carry.next_ingest_ns = t_hi_ns + 1
+                if t_hi_ns >= t2_ns:
+                    break
+                continue
+            _feed_patch(lfp, carry, patch, on_gap)
+            if (
+                carry.next_ingest_ns is None
+                or carry.next_ingest_ns <= t_lo_ns
+            ):
+                # the slice produced no ingest progress (e.g. a
+                # selection quirk returned only already-consumed
+                # samples) — forcing the cursor forward beats spinning
+                # on the same slice
+                log_event("stream_no_progress", t_lo=str(t_lo))
+                carry.next_ingest_ns = t_hi_ns + 1
             if t_hi_ns >= t2_ns:
                 break
-            continue
-        _feed_patch(lfp, carry, patch, on_gap)
-        if (
-            carry.next_ingest_ns is None
-            or carry.next_ingest_ns <= t_lo_ns
-        ):
-            # the slice produced no ingest progress (e.g. a selection
-            # quirk returned only already-consumed samples) — forcing
-            # the cursor forward beats spinning on the same slice
-            log_event("stream_no_progress", t_lo=str(t_lo))
-            carry.next_ingest_ns = t_hi_ns + 1
-        if t_hi_ns >= t2_ns:
-            break
-    return carry.emitted - emitted0
+    emitted = carry.emitted - emitted0
+    reg.counter(
+        "tpudas_stream_samples_emitted_total",
+        "output samples emitted by the stateful stream",
+    ).inc(emitted)
+    return emitted
 
 
 def _reset_engine(carry: StreamCarry) -> None:
@@ -417,6 +452,10 @@ def _feed_patch(lfp, carry: StreamCarry, patch, on_gap) -> None:
                 expected=str(np.datetime64(int(carry.next_ingest_ns), "ns")),
                 got=str(np.datetime64(int(t_ns[i0]), "ns")),
             )
+            get_registry().counter(
+                "tpudas_stream_gaps_detected_total",
+                "full-rate gaps that cold-restarted the stream engine",
+            ).inc()
             if on_gap == "raise":
                 raise Exception("patch merge failed! Gap in data exists")
             _reset_engine(carry)
@@ -563,6 +602,28 @@ def _pow2_blocks(n_units: int, cap: int) -> list:
     return out
 
 
+def _count_block(rows: int, engine: str, t_dev: float) -> None:
+    """Per-dispatched-block observability shared by both stream
+    engines: block count + consumed full-rate rows by engine, and the
+    synced device latency distribution."""
+    reg = get_registry()
+    reg.counter(
+        "tpudas_stream_blocks_total",
+        "stream filter blocks dispatched",
+        labelnames=("engine",),
+    ).inc(engine=engine)
+    reg.counter(
+        "tpudas_stream_samples_consumed_total",
+        "full-rate samples fed through the carried filter state",
+        labelnames=("engine",),
+    ).inc(int(rows), engine=engine)
+    reg.histogram(
+        "tpudas_stream_block_seconds",
+        "per-block device dispatch+sync latency",
+        labelnames=("engine",),
+    ).observe(t_dev, engine=engine)
+
+
 def _pool_with_residual(carry: StreamCarry, new) -> np.ndarray:
     residual = (
         carry.residual
@@ -626,6 +687,7 @@ def _consume_cascade(lfp, carry: StreamCarry, patch, new) -> None:
         y = np.asarray(y)
         t_dev = time.perf_counter() - t0
         lfp.timings["device_s"] += t_dev
+        _count_block(blk.shape[0], ran, t_dev)
         carry.bufs = bufs
         carry.consumed += int(blk.shape[0])
         s = min(carry.skip_left, y.shape[0])
@@ -665,6 +727,7 @@ def _consume_fft(lfp, carry: StreamCarry, patch, new, t_new0_ns) -> None:
         filt = np.asarray(filt)
         t_dev = time.perf_counter() - t0
         lfp.timings["device_s"] += t_dev
+        _count_block(blk.shape[0], "fft", t_dev)
         tail = carry.bufs[1]
         rows = (
             np.concatenate([tail, filt], axis=0) if tail.size else filt

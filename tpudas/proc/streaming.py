@@ -35,12 +35,73 @@ import numpy as np
 
 from tpudas.core.timeutils import to_datetime64, to_timedelta64
 from tpudas.io.spool import spool as make_spool
+from tpudas.obs.health import write_health, write_prom
+from tpudas.obs.registry import get_registry
+from tpudas.obs.trace import span
 from tpudas.proc.lfproc import LFProc
 from tpudas.proc.naming import get_filename
 from tpudas.utils.logging import log_event
 from tpudas.utils.profiling import Counters
 
 __all__ = ["clamp_poll_interval", "run_lowpass_realtime", "run_rolling_realtime"]
+
+
+class _EdgeHealth:
+    """Per-run health bookkeeping for the realtime driver: assembles
+    the ``health.json`` payload (schema: tpudas.obs.health) and drops
+    it — plus the Prometheus exposition — beside the stream carry
+    every round.  Enabled by ``TPUDAS_HEALTH=1`` (or the driver's
+    ``health=True``); write failures are counted and swallowed."""
+
+    def __init__(self, folder, enabled):
+        self.folder = folder
+        self.enabled = enabled
+        self.carry_resumes = 0
+        self.last_error = None
+
+    def write(self, counters, rounds, polls, mode, round_rt, head_lag):
+        if not self.enabled:
+            return
+        write_health(
+            self.folder,
+            {
+                "rounds": rounds,
+                "polls": polls,
+                "mode": mode,
+                "realtime_factor": round(counters.realtime_factor, 3),
+                "round_realtime_factor": round(round_rt, 3),
+                "head_lag_seconds": (
+                    None if head_lag is None else round(head_lag, 3)
+                ),
+                "redundant_ratio": round(counters.redundant_ratio, 4),
+                "carry_resume_count": self.carry_resumes,
+                "last_round_wall_seconds": round(counters.last_wall, 4),
+                "last_error": self.last_error,
+            },
+        )
+        write_prom(self.folder)
+
+
+def _head_lag_seconds(t2, lfp, carry) -> float | None:
+    """Stream-seconds between the fiber head (newest indexed input,
+    ``t2``) and the newest emitted output — the operator's "how far
+    behind live am I" number.  None before the first output."""
+    t_out_ns = None
+    if carry is not None and carry.last_emit_ns is not None:
+        t_out_ns = int(carry.last_emit_ns)
+    else:
+        try:
+            t_out_ns = int(
+                to_datetime64(lfp.get_last_processed_time())
+                .astype("datetime64[ns]")
+                .astype(np.int64)
+            )
+        except Exception:
+            return None
+    t2_ns = int(
+        np.datetime64(t2, "ns").astype(np.int64)
+    )
+    return (t2_ns - t_out_ns) / 1e9
 
 
 def _finite(value) -> float:
@@ -121,6 +182,7 @@ def run_lowpass_realtime(
     rolling_window=None,
     rolling_step=None,
     stateful=None,
+    health=None,
 ):
     """Poll ``source`` and keep the low-pass output current.
 
@@ -150,6 +212,12 @@ def run_lowpass_realtime(
     meshes, and window-DP stay on the rewind path, as does a legacy
     output folder that has files but no carry.
 
+    ``health`` (default: ``TPUDAS_HEALTH=1``) drops an atomic
+    ``health.json`` + ``metrics.prom`` in ``output_folder`` after every
+    processing round (and on a crash), so a cron/node-exporter on the
+    interrogator box can scrape stream liveness without touching the
+    process — see tpudas.obs.health and OBSERVABILITY.md.
+
     Returns the number of rounds that processed data. Terminates when a
     poll sees no new files (reference semantics) or after
     ``max_rounds``.
@@ -178,6 +246,10 @@ def run_lowpass_realtime(
         if v is not None
     }
     counters = counters if counters is not None else Counters()
+    if health is None:
+        health = os.environ.get("TPUDAS_HEALTH", "0") == "1"
+    edge_health = _EdgeHealth(output_folder, bool(health))
+    reg = get_registry()
 
     if stateful is None:
         stateful = os.environ.get("TPUDAS_STREAM_STATEFUL", "1") != "0"
@@ -195,192 +267,256 @@ def run_lowpass_realtime(
     polls = 0
     prev_t2 = None  # previous round's processing head (redundancy metric)
     len_last = None  # spool size at the previous poll (None = no poll yet)
-    while True:
-        polls += 1
-        sp = make_spool(source).update()
-        sub = sp.select(distance=distance) if distance is not None else sp
-        n_now = len(sub)
-        if len_last is not None and n_now == len_last:
-            print("No new data was detected. Real-time processing ended successfully.")
-            break
-        if n_now > 0:
-            joint_extra = {}
-            if rolling_output_folder is not None:
-                from tpudas.proc.joint import JointProc
+    try:
+        while True:
+            polls += 1
+            reg.counter(
+                "tpudas_stream_polls_total", "source spool polls"
+            ).inc()
+            sp = make_spool(source).update()
+            sub = sp.select(distance=distance) if distance is not None else sp
+            n_now = len(sub)
+            if len_last is not None and n_now == len_last:
+                print("No new data was detected. Real-time processing ended successfully.")
+                break
+            if n_now > 0:
+                joint_extra = {}
+                if rolling_output_folder is not None:
+                    from tpudas.proc.joint import JointProc
 
-                lfp = JointProc(sub, mesh=mesh)
-                joint_extra = {
-                    k: v
-                    for k, v in (("rolling_window", rolling_window),
-                                 ("rolling_step", rolling_step))
-                    if v is not None
-                }
-            else:
-                lfp = LFProc(sub, mesh=mesh)
-            lfp.update_processing_parameter(
-                output_sample_interval=d_t,
-                process_patch_size=int(process_patch_size),
-                edge_buff_size=buff_out,
-                **extra,
-                **joint_extra,
-            )
-            lfp.set_output_folder(output_folder, delete_existing=False)
-            if rolling_output_folder is not None:
-                lfp.set_rolling_output_folder(
-                    rolling_output_folder, delete_existing=False
+                    lfp = JointProc(sub, mesh=mesh)
+                    joint_extra = {
+                        k: v
+                        for k, v in (("rolling_window", rolling_window),
+                                     ("rolling_step", rolling_step))
+                        if v is not None
+                    }
+                else:
+                    lfp = LFProc(sub, mesh=mesh)
+                lfp.update_processing_parameter(
+                    output_sample_interval=d_t,
+                    process_patch_size=int(process_patch_size),
+                    edge_buff_size=buff_out,
+                    **extra,
+                    **joint_extra,
                 )
-            rounds += 1
-            print("run number: ", rounds)
-            if stateful and not carry_checked:
-                # one-time disk resolution: resume a persisted carry,
-                # or fall back to rewind mode for a legacy folder that
-                # has outputs but no carry (its resume point is only
-                # expressible as a rewind)
-                carry_checked = True
-                from tpudas.proc.stream import (
-                    carry_matches,
-                    load_carry,
-                    reconcile_outputs,
-                )
-
-                carry = load_carry(output_folder)
-                if carry is not None and not carry_matches(
-                    carry, lfp, start_time
-                ):
-                    raise ValueError(
-                        "persisted stream carry in "
-                        f"{output_folder} was produced under a "
-                        "different start_time or processing "
-                        "parameters; delete it (or the folder) to "
-                        "change configuration"
+                lfp.set_output_folder(output_folder, delete_existing=False)
+                if rolling_output_folder is not None:
+                    lfp.set_rolling_output_folder(
+                        rolling_output_folder, delete_existing=False
                     )
-                if carry is not None:
-                    # patch_size only shapes chunking — honor the
-                    # live setting rather than the persisted one
-                    carry.patch_out = int(process_patch_size)
-                    reconcile_outputs(output_folder, carry)
-                    log_event("stream_resume", emitted=carry.emitted)
-                else:
-                    try:
-                        lfp.get_last_processed_time()
-                        has_outputs = True
-                    except Exception:
-                        has_outputs = False
-                    if has_outputs:
-                        stateful = False
-                        print(
-                            "Existing output folder has no stream "
-                            "carry; continuing in rewind mode"
+                rounds += 1
+                print("run number: ", rounds)
+                if stateful and not carry_checked:
+                    # one-time disk resolution: resume a persisted carry,
+                    # or fall back to rewind mode for a legacy folder that
+                    # has outputs but no carry (its resume point is only
+                    # expressible as a rewind)
+                    carry_checked = True
+                    from tpudas.proc.stream import (
+                        carry_matches,
+                        load_carry,
+                        reconcile_outputs,
+                    )
+
+                    carry = load_carry(output_folder)
+                    if carry is not None and not carry_matches(
+                        carry, lfp, start_time
+                    ):
+                        raise ValueError(
+                            "persisted stream carry in "
+                            f"{output_folder} was produced under a "
+                            "different start_time or processing "
+                            "parameters; delete it (or the folder) to "
+                            "change configuration"
                         )
-                        log_event("stream_legacy_rewind")
+                    if carry is not None:
+                        # patch_size only shapes chunking — honor the
+                        # live setting rather than the persisted one
+                        carry.patch_out = int(process_patch_size)
+                        reconcile_outputs(output_folder, carry)
+                        log_event("stream_resume", emitted=carry.emitted)
+                        edge_health.carry_resumes += 1
+                        reg.counter(
+                            "tpudas_stream_carry_resumes_total",
+                            "rounds resumed from a persisted stream carry",
+                        ).inc()
                     else:
-                        carry = lfp.open_stream(start_time)
-                        # persist BEFORE the first outputs: a crash
-                        # mid-round-1 then still reads as a stateful
-                        # folder (reconcile + resume) instead of
-                        # degrading to rewind mode forever via the
-                        # legacy heuristic above
-                        from tpudas.proc.stream import save_carry
+                        try:
+                            lfp.get_last_processed_time()
+                            has_outputs = True
+                        except Exception:
+                            has_outputs = False
+                        if has_outputs:
+                            stateful = False
+                            print(
+                                "Existing output folder has no stream "
+                                "carry; continuing in rewind mode"
+                            )
+                            log_event("stream_legacy_rewind")
+                        else:
+                            carry = lfp.open_stream(start_time)
+                            # persist BEFORE the first outputs: a crash
+                            # mid-round-1 then still reads as a stateful
+                            # folder (reconcile + resume) instead of
+                            # degrading to rewind mode forever via the
+                            # legacy heuristic above
+                            from tpudas.proc.stream import save_carry
 
-                        save_carry(carry, output_folder)
-            # newest timestamp from the index — no file data is read
-            contents = sub.get_contents()
-            t2 = np.datetime64(contents["time_max"].max())
-            redundant = 0.0
-            if stateful:
-                # carried state: only NEW samples are read/filtered
-                t1 = (
-                    np.datetime64(int(carry.next_ingest_ns), "ns")
-                    if carry.next_ingest_ns is not None
-                    else start_time
-                )
-                data_sec, ch_samples = _covered_workload(contents, t1, t2)
-                with counters.measure(int(ch_samples), data_sec):
-                    lfp.process_stream_increment(carry, t2)
-                from tpudas.proc.stream import save_carry
+                            save_carry(carry, output_folder)
+                # newest timestamp from the index — no file data is read
+                contents = sub.get_contents()
+                t2 = np.datetime64(contents["time_max"].max())
+                redundant = 0.0
+                if stateful:
+                    # carried state: only NEW samples are read/filtered
+                    t1 = (
+                        np.datetime64(int(carry.next_ingest_ns), "ns")
+                        if carry.next_ingest_ns is not None
+                        else start_time
+                    )
+                    data_sec, ch_samples = _covered_workload(contents, t1, t2)
+                    with span(
+                        "stream.round", mode="stateful", round=rounds
+                    ), counters.measure(int(ch_samples), data_sec):
+                        lfp.process_stream_increment(carry, t2)
+                    from tpudas.proc.stream import save_carry
 
-                # saved AFTER the outputs: the carry is never ahead of
-                # the files (crash-only; resume reconciles the rest)
-                save_carry(carry, output_folder)
-            else:
-                resumed_stateful = False
-                if not rewind_wrote:
-                    # a persisted carry means the folder head was
-                    # written by the stateful mode; this rewind write
-                    # breaks the carry's no-newer-outputs invariant,
-                    # so invalidate it — and CONTINUE from the folder
-                    # head (the t_last resume below) rather than
-                    # reprocessing from start_time, leaving every
-                    # stateful-era product file untouched
-                    rewind_wrote = True
-                    from tpudas.proc.stream import discard_carry
-
-                    if discard_carry(output_folder):
-                        resumed_stateful = True
-                        print(
-                            "Removed stale stream carry; rewind mode "
-                            "continues from the folder head"
-                        )
-                if not processed_once and not resumed_stateful:
-                    t1 = start_time
+                    # saved AFTER the outputs: the carry is never ahead of
+                    # the files (crash-only; resume reconciles the rest)
+                    save_carry(carry, output_folder)
                 else:
-                    try:
-                        t_last = lfp.get_last_processed_time()
-                    except IndexError:
-                        # a prior round completed without emitting output
-                        # (stream still shorter than the edge trim) — no
-                        # checkpoint yet, retry from the very start
-                        t_last = None
-                    if t_last is None:
+                    resumed_stateful = False
+                    if not rewind_wrote:
+                        # a persisted carry means the folder head was
+                        # written by the stateful mode; this rewind write
+                        # breaks the carry's no-newer-outputs invariant,
+                        # so invalidate it — and CONTINUE from the folder
+                        # head (the t_last resume below) rather than
+                        # reprocessing from start_time, leaving every
+                        # stateful-era product file untouched
+                        rewind_wrote = True
+                        from tpudas.proc.stream import discard_carry
+
+                        if discard_carry(output_folder):
+                            resumed_stateful = True
+                            print(
+                                "Removed stale stream carry; rewind mode "
+                                "continues from the folder head"
+                            )
+                    if not processed_once and not resumed_stateful:
                         t1 = start_time
                     else:
-                        # rewind (ceil(edge/dt) - 1) output steps, exactly
-                        # on the output grid — ns precision so fractional
-                        # d_t stays seam-free (the resumed run's first
-                        # emitted sample is then t_last + d_t)
-                        rewind_sec = (math.ceil(edge_buffer / d_t) - 1) * d_t
-                        t1 = t_last - to_timedelta64(rewind_sec)
-                data_sec, ch_samples = _covered_workload(contents, t1, t2)
-                if prev_t2 is not None and t1 < prev_t2:
-                    # full-rate samples re-read solely to rebuild the
-                    # filter's transient state (what stateful mode
-                    # eliminates)
-                    _, redundant = _covered_workload(
-                        contents, t1, min(prev_t2, t2)
+                        try:
+                            t_last = lfp.get_last_processed_time()
+                        except IndexError:
+                            # a prior round completed without emitting output
+                            # (stream still shorter than the edge trim) — no
+                            # checkpoint yet, retry from the very start
+                            t_last = None
+                        if t_last is None:
+                            t1 = start_time
+                        else:
+                            # rewind (ceil(edge/dt) - 1) output steps, exactly
+                            # on the output grid — ns precision so fractional
+                            # d_t stays seam-free (the resumed run's first
+                            # emitted sample is then t_last + d_t)
+                            rewind_sec = (math.ceil(edge_buffer / d_t) - 1) * d_t
+                            t1 = t_last - to_timedelta64(rewind_sec)
+                    data_sec, ch_samples = _covered_workload(contents, t1, t2)
+                    if prev_t2 is not None and t1 < prev_t2:
+                        # full-rate samples re-read solely to rebuild the
+                        # filter's transient state (what stateful mode
+                        # eliminates)
+                        _, redundant = _covered_workload(
+                            contents, t1, min(prev_t2, t2)
+                        )
+                        counters.add_redundant(int(redundant))
+                    with span(
+                        "stream.round", mode="rewind", round=rounds
+                    ), counters.measure(int(ch_samples), data_sec):
+                        lfp.process_time_range(t1, t2)
+                prev_t2 = t2
+                round_rt = (
+                    data_sec / counters.last_wall
+                    if counters.last_wall
+                    else 0.0
+                )
+                mode_str = "stateful" if stateful else "rewind"
+                log_event(
+                    "realtime_round",
+                    round=rounds,
+                    upto=str(t2),
+                    mode=mode_str,
+                    data_seconds=round(data_sec, 3),
+                    redundant_samples=int(redundant),
+                    wall_seconds=round(counters.last_wall, 4),
+                    realtime_factor=round(round_rt, 2),
+                    engine=lfp.parameters["engine"],
+                    engine_counts=dict(lfp.engine_counts),
+                    native_windows=lfp.native_windows,
+                )
+                reg.counter(
+                    "tpudas_stream_rounds_total",
+                    "processing rounds completed",
+                    labelnames=("mode",),
+                ).inc(mode=mode_str)
+                reg.histogram(
+                    "tpudas_stream_round_seconds",
+                    "per-round measured processing wall time",
+                ).observe(counters.last_wall)
+                reg.gauge(
+                    "tpudas_stream_realtime_factor",
+                    "last round's data-seconds per wall-second",
+                ).set(round_rt)
+                reg.gauge(
+                    "tpudas_stream_redundant_ratio",
+                    "cumulative fraction of channel-samples re-read to "
+                    "rebuild filter state",
+                ).set(counters.redundant_ratio)
+                # stateful head lag is O(1) off the carry; the rewind
+                # fallback rescans the output index, so only pay it
+                # when an operator is actually scraping health
+                head_lag = (
+                    _head_lag_seconds(
+                        t2, lfp, carry if stateful else None
                     )
-                    counters.add_redundant(int(redundant))
-                with counters.measure(int(ch_samples), data_sec):
-                    lfp.process_time_range(t1, t2)
-            prev_t2 = t2
-            round_rt = (
-                data_sec / counters.last_wall
-                if counters.last_wall
-                else 0.0
-            )
-            log_event(
-                "realtime_round",
-                round=rounds,
-                upto=str(t2),
-                mode="stateful" if stateful else "rewind",
-                data_seconds=round(data_sec, 3),
-                redundant_samples=int(redundant),
-                wall_seconds=round(counters.last_wall, 4),
-                realtime_factor=round(round_rt, 2),
-                engine=lfp.parameters["engine"],
-                engine_counts=dict(lfp.engine_counts),
-                native_windows=lfp.native_windows,
-            )
-            if on_round is not None:
-                on_round(rounds, lfp)
-            processed_once = True
-        # every poll (including an empty first one) sets the growth
-        # baseline: the next no-growth poll terminates (reference
-        # semantics — the loop ends when the spool stops growing,
-        # low_pass_dascore_edge.ipynb:205-207)
-        len_last = n_now
-        if max_rounds is not None and polls >= max_rounds:
-            break
-        sleep_fn(interval)
+                    if (stateful or edge_health.enabled)
+                    else None
+                )
+                if head_lag is not None:
+                    reg.gauge(
+                        "tpudas_stream_head_lag_seconds",
+                        "stream-seconds between the fiber head and the "
+                        "newest emitted output",
+                    ).set(head_lag)
+                edge_health.write(
+                    counters, rounds, polls, mode_str, round_rt, head_lag
+                )
+                if on_round is not None:
+                    on_round(rounds, lfp)
+                processed_once = True
+            # every poll (including an empty first one) sets the growth
+            # baseline: the next no-growth poll terminates (reference
+            # semantics — the loop ends when the spool stops growing,
+            # low_pass_dascore_edge.ipynb:205-207)
+            len_last = n_now
+            if max_rounds is not None and polls >= max_rounds:
+                break
+            sleep_fn(interval)
+    except Exception as exc:
+        # terminal failure: the LAST health snapshot an operator sees
+        # must say why the stream died (the process is about to exit)
+        edge_health.last_error = f"{type(exc).__name__}: {str(exc)[:300]}"
+        get_registry().counter(
+            "tpudas_stream_errors_total",
+            "realtime driver crashes (recorded in health.json)",
+        ).inc()
+        edge_health.write(
+            counters, rounds, polls,
+            "stateful" if stateful else "rewind", 0.0, None,
+        )
+        raise
     return rounds
 
 

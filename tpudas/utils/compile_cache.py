@@ -21,7 +21,42 @@ from __future__ import annotations
 import os
 import tempfile
 
+from tpudas.obs.registry import get_registry
+
 _ENABLED = False
+_LISTENER_INSTALLED = False
+
+
+def _install_metrics_listener() -> None:
+    """Mirror JAX's persistent-cache monitoring events
+    (``/jax/compilation_cache/cache_hits`` / ``cache_misses``) into the
+    obs registry so operators can see warm-restart behavior in
+    ``metrics.prom``.  Private-API tolerant: any failure leaves the
+    cache working, just uncounted."""
+    global _LISTENER_INSTALLED
+    if _LISTENER_INSTALLED:
+        return
+    try:
+        from jax._src import monitoring
+
+        def _on_event(event, **kwargs):
+            if "/jax/compilation_cache/" not in event:
+                return
+            if event.endswith("cache_hits"):
+                get_registry().counter(
+                    "tpudas_compile_cache_hits_total",
+                    "persistent XLA compilation cache hits",
+                ).inc()
+            elif event.endswith("cache_misses"):
+                get_registry().counter(
+                    "tpudas_compile_cache_misses_total",
+                    "persistent XLA compilation cache misses",
+                ).inc()
+
+        monitoring.register_event_listener(_on_event)
+        _LISTENER_INSTALLED = True
+    except Exception:  # pragma: no cover - private-API drift
+        pass
 
 
 def default_cache_dir() -> str:
@@ -64,6 +99,20 @@ def enable_compile_cache(path: str | None = None) -> str:
     # small host-side jits while caching the window kernels; it is
     # deliberately NOT overridden here so operator-set thresholds
     # (JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS) survive
+    _install_metrics_listener()
+    reg = get_registry()
+    reg.gauge(
+        "tpudas_compile_cache_enabled",
+        "1 when the persistent XLA compilation cache is active",
+    ).set(1)
+    try:
+        reg.gauge(
+            "tpudas_compile_cache_entries",
+            "files in the persistent compilation cache directory at "
+            "enable time",
+        ).set(len(os.listdir(path)))
+    except OSError:
+        pass
     _ENABLED = True
     return path
 

@@ -3,7 +3,14 @@
 The reference observes progress with bare prints (lf_das.py:263 etc.);
 tpudas keeps those user-visible prints and adds machine-readable event
 lines behind an opt-in handler (off by default so notebook output
-matches the reference)."""
+matches the reference).
+
+A handler exception must not take down the processing loop, but it
+must not vanish either (ISSUE 2 satellite): every swallowed handler
+failure increments ``tpudas_log_event_drops_total`` in the obs
+registry, and the FIRST drop prints one stderr warning naming the
+exception so a misconfigured handler is diagnosable.
+"""
 
 from __future__ import annotations
 
@@ -12,6 +19,8 @@ import sys
 import time
 
 _handler = None
+_drops = 0  # handler exceptions swallowed (mirrored into the registry)
+_drop_warned = False
 
 
 def set_log_handler(handler):
@@ -30,5 +39,33 @@ def log_event(name: str, **fields):
     event = {"event": name, "ts": time.time(), **fields}
     try:
         _handler(event)
+    except Exception as exc:
+        _record_drop(name, exc)
+
+
+def event_drops() -> int:
+    """Swallowed handler failures so far (process lifetime)."""
+    return _drops
+
+
+def _record_drop(name: str, exc: Exception) -> None:
+    global _drops, _drop_warned
+    _drops += 1
+    try:
+        # lazy import: tpudas.obs.trace imports log_event back
+        from tpudas.obs.registry import get_registry
+
+        get_registry().counter(
+            "tpudas_log_event_drops_total",
+            "log_event handler exceptions swallowed",
+        ).inc()
     except Exception:
-        pass
+        pass  # the drop counter must not introduce its own crash path
+    if not _drop_warned:
+        _drop_warned = True
+        print(
+            f"Warning: log_event handler raised on event {name!r} "
+            f"({exc!r}); this and further handler failures are "
+            "swallowed (counted in tpudas_log_event_drops_total)",
+            file=sys.stderr,
+        )

@@ -2,12 +2,23 @@
 
 Equivalent of the notebooks' tic/toc harness
 (low_pass_dascore.ipynb:171-177) plus the BASELINE.md metrics:
-channel-samples/sec and real-time factor."""
+channel-samples/sec and real-time factor.
+
+Since ISSUE 2 the process-wide source of truth is the
+:mod:`tpudas.obs.registry` metrics registry; :class:`Counters` remains
+the per-run accumulator API but mirrors every measurement into the
+registry (``tpudas_proc_*``), so BENCH artifacts and ``metrics.prom``
+report from one substrate (see :func:`tpudas.obs.registry.headline`).
+"""
 
 from __future__ import annotations
 
+import os
 import time
 from contextlib import contextmanager
+
+from tpudas.obs.registry import get_registry
+from tpudas.utils.logging import log_event
 
 
 class Timer:
@@ -25,7 +36,10 @@ class Timer:
 
 class Counters:
     """Accumulates processed channel-samples and wall time; reports the
-    headline metrics."""
+    headline metrics.  Every accumulation is mirrored into the obs
+    registry (``tpudas_proc_channel_samples_total`` /
+    ``_data_seconds_total`` / ``_wall_seconds_total`` /
+    ``_samples_redundant_total``)."""
 
     def __init__(self):
         self.channel_samples = 0
@@ -38,6 +52,21 @@ class Counters:
         # filter exactly once)
         self.samples_redundant = 0
 
+    def _mirror(self, channel_samples, data_seconds, wall_seconds):
+        reg = get_registry()
+        reg.counter(
+            "tpudas_proc_channel_samples_total",
+            "full-rate channel-samples fed through the processing engine",
+        ).inc(channel_samples)
+        reg.counter(
+            "tpudas_proc_data_seconds_total",
+            "stream-seconds of data processed",
+        ).inc(data_seconds)
+        reg.counter(
+            "tpudas_proc_wall_seconds_total",
+            "wall seconds spent inside measured processing",
+        ).inc(wall_seconds)
+
     @contextmanager
     def measure(self, channel_samples: int, data_seconds: float):
         t0 = time.perf_counter()
@@ -46,11 +75,29 @@ class Counters:
         self.wall_seconds += self.last_wall
         self.channel_samples += int(channel_samples)
         self.data_seconds += float(data_seconds)
+        self._mirror(int(channel_samples), float(data_seconds),
+                     self.last_wall)
+
+    def add_measured(self, channel_samples: int, data_seconds: float,
+                     wall_seconds: float) -> None:
+        """Absorb a measurement timed elsewhere (e.g. bench kernel
+        loops) so its headline numbers come from the registry too."""
+        self.last_wall = float(wall_seconds)
+        self.wall_seconds += self.last_wall
+        self.channel_samples += int(channel_samples)
+        self.data_seconds += float(data_seconds)
+        self._mirror(int(channel_samples), float(data_seconds),
+                     self.last_wall)
 
     def add_redundant(self, channel_samples: int) -> None:
         """Record channel-samples that were re-read/re-filtered solely
         to rebuild filter state (rewind-mode overlap)."""
         self.samples_redundant += int(channel_samples)
+        get_registry().counter(
+            "tpudas_proc_samples_redundant_total",
+            "channel-samples re-read solely to rebuild filter state "
+            "(rewind-mode overlap)",
+        ).inc(int(channel_samples))
 
     @property
     def redundant_ratio(self) -> float:
@@ -72,21 +119,30 @@ class Counters:
 
 
 @contextmanager
-def device_trace(logdir):
+def device_trace(logdir=None):
     """Capture a device-level profiler trace (TensorBoard format) of
     the enclosed block via ``jax.profiler`` — the rebuild's upgrade of
     the reference's wall-clock tic/toc (SURVEY.md §5 tracing row).
 
+    ``logdir=None`` reads ``TPUDAS_TRACE_DIR`` (operators enable
+    tracing by environment alone; a ``ValueError`` if neither is set).
+    The jax import is resolved once at first use and cached at module
+    level — the old per-call import sat on the round hot path.
+
     Robust by design: a backend without profiler support logs a
     ``trace_failed`` event and the block still runs.
     """
-    import jax
-
-    from tpudas.utils.logging import log_event
-
+    if logdir is None:
+        logdir = os.environ.get("TPUDAS_TRACE_DIR")
+        if not logdir:
+            raise ValueError(
+                "device_trace needs a logdir (argument or "
+                "TPUDAS_TRACE_DIR)"
+            )
+    profiler = _get_profiler()
     started = False
     try:
-        jax.profiler.start_trace(str(logdir))
+        profiler.start_trace(str(logdir))
         started = True
     except Exception as exc:  # pragma: no cover - backend specific
         log_event("trace_failed", error=str(exc)[:200])
@@ -95,7 +151,20 @@ def device_trace(logdir):
     finally:
         if started:
             try:
-                jax.profiler.stop_trace()
+                profiler.stop_trace()
                 log_event("trace_written", logdir=str(logdir))
             except Exception as exc:  # pragma: no cover
                 log_event("trace_failed", error=str(exc)[:200])
+
+
+_profiler = None
+
+
+def _get_profiler():
+    """jax.profiler, imported once (hoisted out of device_trace)."""
+    global _profiler
+    if _profiler is None:
+        import jax
+
+        _profiler = jax.profiler
+    return _profiler
