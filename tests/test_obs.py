@@ -51,6 +51,9 @@ def _payload(**over):
         "redundant_ratio": 0.0,
         "carry_resume_count": 1,
         "last_round_wall_seconds": 0.25,
+        "consecutive_failures": 0,
+        "quarantined_files": 0,
+        "degraded": False,
         "last_error": None,
     }
     base.update(over)
@@ -293,7 +296,7 @@ class TestHealth:
         assert path == str(tmp_path / HEALTH_FILENAME)
         got = read_health(str(tmp_path))
         assert got["rounds"] == 3
-        assert got["schema"] == 1
+        assert got["schema"] == 2
         assert got["written_at"] > 0
         # no stray tmp file left behind
         assert sorted(os.listdir(tmp_path)) == [HEALTH_FILENAME]
@@ -320,7 +323,7 @@ class TestHealth:
         assert read_health(str(tmp_path)) is None
 
     def test_validate_schema(self):
-        validate_health({**_payload(), "schema": 1, "written_at": 0.0})
+        validate_health({**_payload(), "schema": 2, "written_at": 0.0})
         with pytest.raises(ValueError):
             validate_health(
                 {**_payload(), "schema": 99, "written_at": 0.0}

@@ -1,0 +1,638 @@
+"""tpudas.resilience: failure taxonomy, retry/backoff, quarantine
+ledger, the per-round fault boundary in the realtime drivers, and the
+crash-resume-equivalence acceptance tests (ISSUE 3).
+
+The acceptance bar: for every FaultPlan site (spool read, index
+update, round body, carry save) a transient fault is retried and the
+final output folder is BYTE-identical to the fault-free run; a
+persistently corrupt file ends quarantined with the driver still
+alive, visible in health.json and the metrics registry.
+"""
+
+import hashlib
+import json
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from tpudas.io.registry import write_patch
+from tpudas.core.timeutils import to_datetime64
+from tpudas.obs.health import read_health
+from tpudas.obs.registry import MetricsRegistry, use_registry
+from tpudas.proc.streaming import run_lowpass_realtime, run_rolling_realtime
+from tpudas.resilience.faults import (
+    FAULT_SITES,
+    FaultBoundary,
+    RetryPolicy,
+    SpoolReadError,
+    TransientFaultError,
+    classify_failure,
+)
+from tpudas.resilience.quarantine import QUARANTINE_FILENAME, QuarantineLedger
+from tpudas.testing import (
+    FaultPlan,
+    FaultSpec,
+    install_fault_plan,
+    make_synthetic_spool,
+    synthetic_patch,
+    write_corrupt_file,
+)
+
+T0 = "2023-03-22T00:00:00"
+FS = 50.0
+FILE_SEC = 20.0
+NCH = 4
+
+# a fast policy for tests: no real sleeping, low thresholds
+FAST = RetryPolicy(base_delay=0.0, max_delay=0.0, jitter=0.0,
+                   quarantine_after=2, quarantine_retry=900.0)
+
+
+def _spool(src, n_files=2, start=T0, prefix="raw"):
+    return make_synthetic_spool(
+        src, n_files=n_files, file_duration=FILE_SEC, fs=FS, n_ch=NCH,
+        noise=0.01, start=start, prefix=prefix,
+    )
+
+
+def _append_one(src, index):
+    t0 = to_datetime64(T0).astype("datetime64[ns]")
+    step = np.timedelta64(int(round(1e9 / FS)), "ns")
+    n = int(FILE_SEC * FS)
+    p = synthetic_patch(
+        t0=t0 + index * n * step, duration=FILE_SEC, fs=FS, n_ch=NCH,
+        seed=index, phase_origin=t0, noise=0.01,
+    )
+    write_patch(p, os.path.join(src, f"raw_{index:04d}.h5"))
+
+
+def _drive(src, out, policy=FAST, engine=None, feed_third=False, **kw):
+    """One realtime run over ``src`` into ``out``; ``feed_third``
+    appends a third file via the injected sleep (a second round)."""
+    def sleep(_):
+        if feed_third and not os.path.isfile(
+            os.path.join(src, "raw_0002.h5")
+        ):
+            _append_one(src, 2)
+
+    return run_lowpass_realtime(
+        source=src,
+        output_folder=out,
+        start_time=T0,
+        output_sample_interval=1.0,
+        edge_buffer=5.0,
+        process_patch_size=20,
+        poll_interval=0.0,
+        sleep_fn=sleep,
+        fault_policy=policy,
+        engine=engine,
+        **kw,
+    )
+
+
+def _hashes(out):
+    """{basename: sha256} of the product files in ``out``."""
+    return {
+        f: hashlib.sha256(
+            open(os.path.join(out, f), "rb").read()
+        ).hexdigest()
+        for f in sorted(os.listdir(out))
+        if f.endswith(".h5")
+    }
+
+
+class TestClassify:
+    def test_taxonomy(self):
+        assert classify_failure(OSError("nfs hiccup")) == "transient"
+        assert classify_failure(TransientFaultError("x")) == "transient"
+        assert classify_failure(TimeoutError("t")) == "transient"
+        # file-attributed: OSError inside -> transient, decode -> corrupt
+        assert classify_failure(
+            SpoolReadError("/d/f.h5", OSError("short read"))
+        ) == "transient"
+        assert classify_failure(
+            SpoolReadError("/d/f.h5", ValueError("not a dasdae file"))
+        ) == "corrupt"
+        # config/programming errors are fatal, as is the reference's
+        # gap raise (a bare Exception)
+        assert classify_failure(ValueError("bad param")) == "fatal"
+        assert classify_failure(TypeError("bad call")) == "fatal"
+        assert classify_failure(
+            Exception("patch merge failed! Gap in data exists")
+        ) == "fatal"
+        assert classify_failure(MemoryError()) == "fatal"
+
+    def test_spool_read_error_carries_path(self):
+        e = SpoolReadError("/data/raw_0001.h5", ValueError("boom"))
+        assert e.path == "/data/raw_0001.h5"
+        assert "raw_0001.h5" in str(e) and "boom" in str(e)
+
+
+class TestRetryPolicy:
+    def test_backoff_deterministic_capped(self):
+        p = RetryPolicy(base_delay=1.0, max_delay=8.0, multiplier=2.0,
+                        jitter=0.1, seed=7)
+        d = [p.delay(a) for a in range(6)]
+        assert d == [p.delay(a) for a in range(6)]  # deterministic
+        # capped exponential: base values 1,2,4,8,8,8 with <=10% jitter
+        for got, base in zip(d, [1, 2, 4, 8, 8, 8]):
+            assert base <= got <= base * 1.1
+        # different seed -> different jitter (same bounds)
+        assert [RetryPolicy(seed=8, jitter=0.1).delay(a) for a in range(6)] != d
+
+    def test_zero_policy_for_tests(self):
+        assert FAST.delay(0) == 0.0 and FAST.delay(5) == 0.0
+
+
+class TestFaultPlan:
+    def test_fires_on_nth_hit_only(self):
+        plan = FaultPlan(FaultSpec("round.body", at=2))
+        plan.hit("round.body", {})  # hit 1: no fire
+        with pytest.raises(TransientFaultError):
+            plan.hit("round.body", {})
+        plan.hit("round.body", {})  # hit 3: window passed
+        assert plan.fired == [("round.body", "raise", 2)]
+        assert plan.hits["round.body"] == 3
+
+    def test_exc_class_and_instance(self):
+        with pytest.raises(RuntimeError):
+            FaultPlan(FaultSpec("carry.save", exc=RuntimeError)).hit(
+                "carry.save", {}
+            )
+        marker = ValueError("exact instance")
+        plan = FaultPlan(FaultSpec("carry.save", exc=marker))
+        with pytest.raises(ValueError) as ei:
+            plan.hit("carry.save", {})
+        assert ei.value is marker
+
+    def test_truncate_and_delay_and_match(self, tmp_path):
+        f = tmp_path / "x.h5"
+        f.write_bytes(b"A" * 100)
+        slept = []
+        plan = FaultPlan(
+            FaultSpec("spool.read", action="truncate", nbytes=10),
+            FaultSpec("index.update", action="delay", seconds=0.25,
+                      sleep_fn=slept.append),
+            FaultSpec("round.body", at=1, times=99, match="only-this"),
+        )
+        plan.hit("spool.read", {"path": str(f)})
+        assert f.stat().st_size == 10
+        plan.hit("index.update", {"directory": str(tmp_path)})
+        assert slept == [0.25]
+        plan.hit("round.body", {"path": "something-else"})  # no raise
+        with pytest.raises(TransientFaultError):
+            plan.hit("round.body", {"path": "x/only-this/y"})
+
+    def test_install_scopes(self):
+        from tpudas.resilience.faults import fault_point
+
+        plan = FaultPlan(FaultSpec("round.body"))
+        with install_fault_plan(plan):
+            with pytest.raises(TransientFaultError):
+                fault_point("round.body")
+        fault_point("round.body")  # uninstalled: no-op
+
+    def test_unknown_site_and_action_rejected(self):
+        with pytest.raises(ValueError, match="site"):
+            FaultSpec("not.a.site")
+        with pytest.raises(ValueError, match="action"):
+            FaultSpec("round.body", action="explode")
+
+
+class TestQuarantineLedger:
+    def test_threshold_excludes_and_persists(self, tmp_path):
+        led = QuarantineLedger(str(tmp_path))
+        assert led.record_failure("/src/a.h5", "e1", now=100.0,
+                                  threshold=2, retry_interval=60.0) is None
+        assert led.quarantined_count == 0
+        assert led.record_failure("/src/a.h5", "e2", now=110.0,
+                                  threshold=2, retry_interval=60.0) == "added"
+        assert led.quarantined_count == 1
+        assert led.excluded(now=120.0) == {"a.h5"}
+        # probe window opens at 110 + 60
+        assert led.excluded(now=171.0) == frozenset()
+        assert led.probe_open_names(now=171.0) == ["a.h5"]
+        # reload from disk: same state
+        led2 = QuarantineLedger(str(tmp_path))
+        assert led2.quarantined_count == 1
+        assert led2.entry("a.h5")["fails"] == 2
+
+    def test_failed_probe_escalates_capped(self, tmp_path):
+        led = QuarantineLedger(str(tmp_path))
+        now = 0.0
+        assert led.record_failure("b.h5", "e", now=now, threshold=1,
+                                  retry_interval=100.0) == "added"
+        waits = [led.entry("b.h5")["retry_at"] - now]
+        for _ in range(5):
+            now = led.entry("b.h5")["retry_at"]  # probe opens
+            assert led.record_failure(
+                "b.h5", "e", now=now, threshold=1, retry_interval=100.0
+            ) == "requarantined"
+            waits.append(led.entry("b.h5")["retry_at"] - now)
+        assert waits == [100.0, 200.0, 400.0, 800.0, 800.0, 800.0]
+
+    def test_probe_pending_survives_failure(self, tmp_path):
+        led = QuarantineLedger(str(tmp_path))
+        led.record_failure("c.h5", "e", now=0.0, threshold=1,
+                           retry_interval=10.0, source="read")
+        led.mark_probe_pending("c.h5")
+        assert led.probe_pending_names() == ["c.h5"]
+        # a failed probe read clears the flag AND keeps escalation
+        led.record_failure("c.h5", "e2", now=11.0, threshold=1,
+                           retry_interval=10.0, source="read")
+        assert led.probe_pending_names() == []
+        assert led.entry("c.h5")["rounds"] == 2
+
+    def test_success_releases_clean_slate(self, tmp_path):
+        led = QuarantineLedger(str(tmp_path))
+        led.record_failure("c.h5", "e", now=0.0, threshold=1,
+                           retry_interval=10.0)
+        assert led.quarantined_count == 1
+        assert led.record_success("/any/prefix/c.h5")
+        assert led.quarantined_count == 0 and led.entry("c.h5") is None
+        assert not led.record_success("c.h5")  # idempotent
+
+    def test_corrupt_ledger_degrades_to_empty(self, tmp_path):
+        (tmp_path / QUARANTINE_FILENAME).write_text("{not json")
+        led = QuarantineLedger(str(tmp_path))
+        assert led.quarantined_count == 0
+        led.record_failure("d.h5", "e", now=0.0)  # and it can re-save
+        assert json.load(open(tmp_path / QUARANTINE_FILENAME))["files"]
+
+
+class TestTransientRetryByteIdentical:
+    """Acceptance: for every fault site, one transient fault is
+    retried and the final output folder is byte-identical to the
+    fault-free run (stateful carry mode, the default)."""
+
+    # carry.save at=2 is the nastiest case: the save AFTER round 1's
+    # outputs fails, so the retry must reconcile the partial emission
+    SPECS = {
+        "spool.read": FaultSpec("spool.read", at=1),
+        "index.update": FaultSpec("index.update", at=1),
+        "round.body": FaultSpec("round.body", at=1),
+        "carry.save": FaultSpec("carry.save", at=2),
+    }
+
+    @pytest.fixture(scope="class")
+    def control(self, tmp_path_factory):
+        td = tmp_path_factory.mktemp("control")
+        src, out = str(td / "src"), str(td / "out")
+        _spool(src)
+        rounds = _drive(src, out)
+        assert rounds >= 1
+        return _hashes(out)
+
+    @pytest.mark.parametrize("site", sorted(SPECS))
+    def test_retried_and_identical(self, tmp_path, control, site):
+        src, out = str(tmp_path / "src"), str(tmp_path / "out")
+        _spool(src)
+        plan = FaultPlan(self.SPECS[site])
+        reg = MetricsRegistry()
+        with use_registry(reg), install_fault_plan(plan):
+            rounds = _drive(src, out)
+        assert rounds >= 1  # the driver survived
+        assert plan.fired, f"fault at {site} never fired"
+        assert reg.value(
+            "tpudas_stream_round_failures_total", kind="transient"
+        ) >= 1
+        assert reg.value("tpudas_stream_retries_total") >= 1
+        # after recovery the degradation gauges are back to healthy
+        assert reg.value("tpudas_stream_consecutive_failures") == 0
+        assert reg.value("tpudas_stream_degraded") == 0
+        got = _hashes(out)
+        assert got == control, f"outputs diverged after {site} fault"
+
+
+class TestCrashResumeEquivalence:
+    """Satellite: kill the driver (fatal injected fault) at each site
+    mid-run, resume, and the outputs are byte-identical to an
+    uninterrupted run — cascade and FFT engines."""
+
+    # KeyboardInterrupt bypasses every `except Exception` (the fault
+    # boundary included) exactly like a SIGINT kill on the edge box —
+    # the truest mid-round crash the harness can inject
+    SPECS = {
+        "spool.read": FaultSpec("spool.read", at=2, exc=KeyboardInterrupt),
+        "index.update": FaultSpec(
+            "index.update", at=2, exc=KeyboardInterrupt
+        ),
+        "round.body": FaultSpec("round.body", at=2, exc=KeyboardInterrupt),
+        "carry.save": FaultSpec("carry.save", at=2, exc=KeyboardInterrupt),
+    }
+
+    @pytest.fixture(scope="class")
+    def controls(self, tmp_path_factory):
+        out = {}
+        for engine in ("cascade", "fft"):
+            td = tmp_path_factory.mktemp(f"ctrl_{engine}")
+            src, dst = str(td / "src"), str(td / "out")
+            _spool(src)
+            rounds = _drive(src, dst, engine=engine, feed_third=True)
+            assert rounds == 2
+            out[engine] = _hashes(dst)
+        return out
+
+    @pytest.mark.parametrize("engine", ["cascade", "fft"])
+    @pytest.mark.parametrize("site", sorted(SPECS))
+    def test_kill_resume_identical(self, tmp_path, controls, engine, site):
+        src, out = str(tmp_path / "src"), str(tmp_path / "out")
+        _spool(src)
+        plan = FaultPlan(self.SPECS[site])
+        with install_fault_plan(plan):
+            with pytest.raises(KeyboardInterrupt):
+                _drive(src, out, engine=engine, feed_third=True)
+        assert plan.fired  # it really died at the injected site
+        # resume (no faults): same crash-only path a process restart takes
+        rounds = _drive(src, out, engine=engine, feed_third=True)
+        assert rounds >= 1
+        assert _hashes(out) == controls[engine], (
+            f"{engine}: resume after {site} kill diverged from "
+            "uninterrupted run"
+        )
+
+
+class TestQuarantineEndToEnd:
+    def test_scan_corrupt_file_quarantined_driver_alive(
+        self, tmp_path, monkeypatch
+    ):
+        """A file that never scans (garbage bytes) is struck every
+        poll, quarantined at the threshold, and the driver terminates
+        normally with the skip visible in health.json, metrics, and
+        the ledger."""
+        monkeypatch.setenv("TPUDAS_HEALTH", "1")
+        src, out = str(tmp_path / "src"), str(tmp_path / "out")
+        _spool(src)
+        write_corrupt_file(os.path.join(src, "raw_0099.h5"))
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            rounds = _drive(src, out)
+        assert rounds >= 1  # good files processed; driver alive
+        led = QuarantineLedger(out)
+        assert led.quarantined_names() == ["raw_0099.h5"]
+        assert reg.value("tpudas_stream_quarantined_files") == 1
+        assert reg.value("tpudas_stream_quarantine_added_total") == 1
+        health = read_health(out)
+        assert health is not None
+        assert health["quarantined_files"] == 1
+        assert health["degraded"] is True
+
+    def test_payload_corrupt_file_quarantined_then_released(
+        self, tmp_path
+    ):
+        """Scan passes but every payload read of ONE file raises a
+        decode error: the round retries, the file is quarantined (the
+        driver finishes on the good files), and after the slow-retry
+        window a repaired file is released and processed."""
+        clk = {"t": 1000.0}
+        policy = RetryPolicy(
+            base_delay=0.0, max_delay=0.0, jitter=0.0,
+            quarantine_after=2, quarantine_retry=60.0,
+            clock=lambda: clk["t"],
+        )
+        src, out = str(tmp_path / "src"), str(tmp_path / "out")
+        _spool(src, n_files=3)
+        plan = FaultPlan(
+            FaultSpec("spool.read", at=1, times=9999, exc=ValueError,
+                      match="raw_0002"),
+        )
+        reg = MetricsRegistry()
+        with use_registry(reg), install_fault_plan(plan):
+            rounds = _drive(src, out, policy=policy)
+        assert rounds >= 1
+        led = QuarantineLedger(out)
+        assert led.quarantined_names() == ["raw_0002.h5"]
+        assert reg.value(
+            "tpudas_stream_round_failures_total", kind="corrupt"
+        ) >= 2
+        n_outputs_degraded = len(_hashes(out))
+        assert n_outputs_degraded > 0  # files 0-1 were emitted
+        # the "interrogator finished writing it late" path: the file is
+        # fine now, the probe window opens, the driver releases and
+        # processes it
+        clk["t"] += 120.0
+        with use_registry(reg):
+            rounds2 = _drive(src, out, policy=policy)
+        assert rounds2 >= 1
+        assert QuarantineLedger(out).quarantined_count == 0
+        assert reg.value("tpudas_stream_quarantine_released_total") == 1
+        assert len(_hashes(out)) > n_outputs_degraded
+
+    def test_still_corrupt_probe_escalates_not_released(self, tmp_path):
+        """A probe read that fails again must re-quarantine WITH the
+        entry's backoff history (doubled wait), not release-and-restart
+        the strike cascade — and the release counter must not move."""
+        clk = {"t": 1000.0}
+        policy = RetryPolicy(
+            base_delay=0.0, max_delay=0.0, jitter=0.0,
+            quarantine_after=2, quarantine_retry=60.0,
+            clock=lambda: clk["t"],
+        )
+        src, out = str(tmp_path / "src"), str(tmp_path / "out")
+        _spool(src, n_files=3)
+
+        def plan():
+            return FaultPlan(
+                FaultSpec("spool.read", at=1, times=9999, exc=ValueError,
+                          match="raw_0002"),
+            )
+
+        reg = MetricsRegistry()
+        with use_registry(reg), install_fault_plan(plan()):
+            _drive(src, out, policy=policy)
+        e = QuarantineLedger(out).entry("raw_0002.h5")
+        assert e["quarantined"] and e["rounds"] == 1
+        assert e["source"] == "read"
+        corrupt_before = reg.value(
+            "tpudas_stream_round_failures_total", kind="corrupt"
+        )
+        clk["t"] = e["retry_at"] + 1.0  # probe window opens
+        with use_registry(reg), install_fault_plan(plan()):
+            rounds2 = _drive(src, out, policy=policy)
+        assert rounds2 >= 1  # driver alive, probe cost ONE failed round
+        e2 = QuarantineLedger(out).entry("raw_0002.h5")
+        assert e2["quarantined"] and e2["rounds"] == 2
+        assert e2["retry_at"] - e2["last_failed_at"] == pytest.approx(120.0)
+        assert reg.value(
+            "tpudas_stream_quarantine_requarantined_total"
+        ) == 1
+        assert reg.value("tpudas_stream_quarantine_released_total") == 0
+        assert reg.value(
+            "tpudas_stream_round_failures_total", kind="corrupt"
+        ) == corrupt_before + 1
+
+    def test_quarantine_false_disables_ledger(self, tmp_path):
+        src, out = str(tmp_path / "src"), str(tmp_path / "out")
+        _spool(src)
+        write_corrupt_file(os.path.join(src, "raw_0099.h5"))
+        rounds = _drive(src, out, quarantine=False)
+        assert rounds >= 1
+        assert not os.path.isfile(os.path.join(out, QUARANTINE_FILENAME))
+
+
+class TestFatalAndExhaustion:
+    def test_fatal_propagates_immediately(self, tmp_path):
+        src, out = str(tmp_path / "src"), str(tmp_path / "out")
+        _spool(src)
+        reg = MetricsRegistry()
+        plan = FaultPlan(FaultSpec("round.body", exc=TypeError))
+        with use_registry(reg), install_fault_plan(plan):
+            with pytest.raises(TypeError):
+                _drive(src, out)
+        assert reg.value("tpudas_stream_retries_total") == 0
+        assert reg.value(
+            "tpudas_stream_round_failures_total", kind="fatal"
+        ) == 1
+        assert reg.value("tpudas_stream_errors_total") == 1
+
+    def test_persistent_transient_exhausts_and_propagates(
+        self, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv("TPUDAS_HEALTH", "1")
+        src, out = str(tmp_path / "src"), str(tmp_path / "out")
+        _spool(src)
+        policy = RetryPolicy(base_delay=0.0, max_delay=0.0, jitter=0.0,
+                             max_consecutive=2)
+        plan = FaultPlan(
+            FaultSpec("index.update", at=1, times=9999)
+        )
+        reg = MetricsRegistry()
+        with use_registry(reg), install_fault_plan(plan):
+            with pytest.raises(TransientFaultError):
+                _drive(src, out, policy=policy)
+        assert reg.value("tpudas_stream_retries_total") == 2
+        health = read_health(out)
+        assert health is not None and health["last_error"] is not None
+        assert "TransientFaultError" in health["last_error"]
+
+    def test_rolling_driver_retries_too(self, tmp_path):
+        src, out = str(tmp_path / "src"), str(tmp_path / "out")
+        _spool(src)
+        reg = MetricsRegistry()
+        plan = FaultPlan(FaultSpec("round.body", at=1))
+        from tpudas.core.units import s as sec
+
+        with use_registry(reg), install_fault_plan(plan):
+            rounds = run_rolling_realtime(
+                source=src, output_folder=out, window=1.0 * sec,
+                step=1.0 * sec, poll_interval=0.0,
+                sleep_fn=lambda _: None, fault_policy=FAST,
+            )
+        assert rounds >= 1
+        assert reg.value("tpudas_stream_retries_total") == 1
+        assert len(_hashes(out)) == 2  # both patches still processed
+
+
+class TestBoundaryUnit:
+    def test_success_resets_consecutive(self):
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            b = FaultBoundary(RetryPolicy(base_delay=0.0, jitter=0.0))
+            d1 = b.on_failure(OSError("x"))
+            assert (d1.kind, d1.propagate) == ("transient", False)
+            assert b.consecutive == 1 and b.degraded
+            b.on_success()
+            assert b.consecutive == 0 and not b.degraded
+            assert b.last_error is None
+
+    def test_health_degradation_fields_flow(self, tmp_path):
+        """The boundary's state lands in health.json via the driver's
+        _EdgeHealth (consecutive_failures while retrying)."""
+        from tpudas.proc.streaming import _EdgeHealth
+        from tpudas.utils.profiling import Counters
+
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            b = FaultBoundary(RetryPolicy(base_delay=0.0, jitter=0.0))
+            b.on_failure(OSError("flaky mount"))
+            eh = _EdgeHealth(str(tmp_path), True, b)
+            eh.write(Counters(), 1, 2, "stateful", 0.0, None)
+        got = read_health(str(tmp_path))
+        assert got["consecutive_failures"] == 1
+        assert got["degraded"] is True
+        assert "flaky mount" in got["last_error"]
+
+
+class TestGapToleranceAlias:
+    def test_correct_spelling_accepted(self):
+        from tpudas.proc.lfproc import LFProc
+
+        lfp = LFProc()
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # no deprecation for the fix
+            p = lfp.update_processing_parameter(data_gap_tolerance=7.5)
+        assert p["data_gap_tolorance"] == 7.5  # storage keeps ref key
+
+    def test_legacy_spelling_warns_once(self):
+        import tpudas.proc.lfproc as lfproc_mod
+        from tpudas.proc.lfproc import LFProc
+
+        lfproc_mod._GAP_ALIAS_WARNED = False
+        lfp = LFProc()
+        with pytest.warns(DeprecationWarning, match="misspelling"):
+            lfp.update_processing_parameter(data_gap_tolorance=3.0)
+        assert lfp.parameters["data_gap_tolorance"] == 3.0
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # second use: silent
+            lfp.update_processing_parameter(data_gap_tolorance=4.0)
+
+    def test_conflicting_values_rejected(self, tmp_path):
+        from tpudas.proc.lfproc import LFProc
+
+        with pytest.raises(ValueError, match="disagree"):
+            LFProc().update_processing_parameter(
+                data_gap_tolerance=5.0, data_gap_tolorance=10.0
+            )
+        with pytest.raises(ValueError, match="disagree"):
+            run_lowpass_realtime(
+                source=str(tmp_path),
+                output_folder=str(tmp_path / "out"),
+                start_time=T0,
+                output_sample_interval=1.0,
+                edge_buffer=5.0,
+                process_patch_size=20,
+                data_gap_tolerance=5.0,
+                data_gap_tolorance=10.0,
+            )
+
+    def test_agreeing_values_pass(self):
+        from tpudas.proc.lfproc import LFProc
+
+        p = LFProc().update_processing_parameter(
+            data_gap_tolerance=5.0, data_gap_tolorance=5.0
+        )
+        assert p["data_gap_tolorance"] == 5.0
+
+    def test_driver_forwards_correct_spelling(self, tmp_path):
+        src, out = str(tmp_path / "src"), str(tmp_path / "out")
+        _spool(src, n_files=1)
+        seen = {}
+
+        def on_round(r, lfp):
+            seen["tol"] = lfp.parameters["data_gap_tolorance"]
+
+        _drive(src, out, data_gap_tolerance=42.0, on_round=on_round)
+        assert seen["tol"] == 42.0
+
+
+class TestNarrowedLegacyProbe:
+    def test_fresh_folder_probe_logs_no_outputs(self, tmp_path):
+        """Satellite: the legacy-folder probe no longer swallows
+        arbitrary exceptions — the expected empty-folder signal is
+        logged as an event instead."""
+        from tpudas.utils.logging import set_log_handler
+
+        src, out = str(tmp_path / "src"), str(tmp_path / "out")
+        _spool(src, n_files=1)
+        events = []
+        set_log_handler(events.append)
+        try:
+            _drive(src, out)
+        finally:
+            set_log_handler(None)
+        probes = [
+            e for e in events if e["event"] == "stream_no_prior_outputs"
+        ]
+        assert probes and "IndexError" in probes[0]["reason"]

@@ -1,0 +1,170 @@
+"""Broad-except lint: no NEW silent ``except Exception`` blocks.
+
+The robustness PR's guard rail: a handler that catches ``Exception``
+(or ``BaseException``, or is a bare ``except:``) and neither re-raises
+nor logs is a black hole — exactly the pattern that made real IO
+errors read as "no outputs" in the realtime driver
+(tpudas/proc/streaming.py legacy-folder probe, fixed in PR 3).  This
+lint parses every source under ``tpudas/``, ``tools/`` and
+``bench.py`` with ``ast`` and fails on any such handler that is not in
+the checked-in allowlist of pre-existing sites
+(``tools/except_allowlist.txt``, one ``path::qualname`` per line).
+
+"Logs" means the handler body (recursively) performs any of: a
+``raise``; a call to ``log_event`` / ``print`` / ``warnings.warn`` /
+``_record_drop``; a metric update (``.inc`` / ``.observe`` / ``.set``
+on anything); or a ``logging``-style ``.warning/.error/.exception``
+call.  The allowlist is keyed by enclosing-function qualname (not line
+number) so unrelated edits to a file do not churn it.
+
+Run from anywhere:
+
+    python tools/check_excepts.py
+
+Exit code 0 = clean; 1 = violations (printed one per line).  Wired
+into tier-1 via tests/test_excepts_lint.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCAN_ROOTS = ("tpudas", "tools")
+SCAN_FILES = ("bench.py",)
+ALLOWLIST = os.path.join("tools", "except_allowlist.txt")
+
+_BROAD_NAMES = {"Exception", "BaseException"}
+# a call to any of these names counts as "the failure was surfaced"
+_LOG_FUNC_NAMES = {"log_event", "print", "_record_drop"}
+# ...as does a method call with any of these attribute names (metric
+# updates, logging loggers, stderr writes)
+_LOG_ATTR_NAMES = {
+    "inc", "observe", "set", "warn", "warning", "error", "exception",
+    "write", "log_event",
+}
+
+
+def iter_source_files(repo: str = REPO):
+    for root_name in SCAN_ROOTS:
+        for dirpath, _dirnames, filenames in os.walk(
+            os.path.join(repo, root_name)
+        ):
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+    for fn in SCAN_FILES:
+        path = os.path.join(repo, fn)
+        if os.path.isfile(path):
+            yield path
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:  # bare except:
+        return True
+    names = []
+    for node in [t] + (list(t.elts) if isinstance(t, ast.Tuple) else []):
+        if isinstance(node, ast.Name):
+            names.append(node.id)
+        elif isinstance(node, ast.Attribute):
+            names.append(node.attr)
+    return any(n in _BROAD_NAMES for n in names)
+
+
+def _handles(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body re-raises or logs (see module doc)."""
+    for node in ast.walk(ast.Module(body=handler.body, type_ignores=[])):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in _LOG_FUNC_NAMES:
+                return True
+            if isinstance(f, ast.Attribute) and f.attr in _LOG_ATTR_NAMES:
+                return True
+    return False
+
+
+def _qualnames(tree: ast.AST) -> dict:
+    """{node id: dotted qualname of the enclosing def/class chain}."""
+    out = {}
+
+    def visit(node, stack):
+        for child in ast.iter_child_nodes(node):
+            s = stack
+            if isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                s = stack + [child.name]
+            out[id(child)] = ".".join(s) or "<module>"
+            visit(child, s)
+
+    out[id(tree)] = "<module>"
+    visit(tree, [])
+    return out
+
+
+def lint_source(rel: str, text: str, allowed: set) -> list:
+    """Violation strings for one source file (empty = clean)."""
+    try:
+        tree = ast.parse(text)
+    except SyntaxError as exc:
+        return [f"{rel}: unparseable ({exc})"]
+    quals = _qualnames(tree)
+    problems = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad(node) or _handles(node):
+            continue
+        key = f"{rel}::{quals.get(id(node), '<module>')}"
+        if key in allowed:
+            continue
+        problems.append(
+            f"{key}: silent broad except at line {node.lineno} — "
+            "re-raise, log_event, or add the site to "
+            f"{ALLOWLIST} with a justification"
+        )
+    return problems
+
+
+def load_allowlist(repo: str = REPO) -> set:
+    path = os.path.join(repo, ALLOWLIST)
+    allowed = set()
+    if os.path.isfile(path):
+        with open(path) as fh:
+            for line in fh:
+                line = line.split("#", 1)[0].strip()
+                if line:
+                    allowed.add(line)
+    return allowed
+
+
+def main(argv=None) -> int:
+    repo = (argv or [None, REPO])[1] if argv and len(argv) > 1 else REPO
+    allowed = load_allowlist(repo)
+    problems = []
+    n_files = 0
+    for path in iter_source_files(repo):
+        rel = os.path.relpath(path, repo).replace(os.sep, "/")
+        with open(path) as fh:
+            text = fh.read()
+        problems.extend(lint_source(rel, text, allowed))
+        n_files += 1
+    for p in problems:
+        print(p)
+    if not problems:
+        print(
+            f"check_excepts: OK ({n_files} files, "
+            f"{len(allowed)} allowlisted sites)"
+        )
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

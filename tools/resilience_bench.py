@@ -1,0 +1,300 @@
+"""Fault-boundary bench (CPU): the ISSUE 3 acceptance artifact.
+
+Three sections, written to one JSON (default ``BENCH_pr03.json``):
+
+- ``fault_injection`` — for every :data:`tpudas.resilience.FAULT_SITES`
+  site, drive the stateful realtime loop with ONE injected transient
+  fault at that site and assert the driver survives, the retry counter
+  moved, and the final output folder is BYTE-identical (sha256 per
+  file) to the fault-free control run;
+- ``quarantine`` — a persistently corrupt source file: the driver must
+  finish alive, with the skip visible in ``health.json``
+  (``quarantined_files``/``degraded``), the
+  ``tpudas_stream_quarantined_files`` gauge, and the
+  ``.quarantine.json`` ledger;
+- ``overhead`` — the steady-round cost of the fault boundary.  Per
+  steady round the boundary adds: one ``round.body`` + one
+  ``index.update`` + one ``carry.save`` + per-file ``spool.read``
+  fault-point checks (no plan installed), one empty-ledger exclusion
+  check, and ``on_success`` (two gauge sets).  A whole-drive A/B
+  cannot resolve that under shared-CPU scheduler noise (BENCH_pr02
+  taught us this), so the bundle is replayed deterministically
+  (2x-overcounted read volume) and reported as a fraction of the
+  measured steady-round floor.  Acceptance: < 1%.
+
+    JAX_PLATFORMS=cpu python tools/resilience_bench.py [--out PATH]
+
+Exit code 0 when every acceptance condition holds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+FS = 100.0
+FILE_SEC = 30.0
+N_CH = 16
+DT_OUT = 1.0
+EDGE_SEC = 40.0
+PATCH_OUT = 100
+T0 = "2023-03-22T00:00:00"
+
+
+def _make_src(src, n_files):
+    from tpudas.testing import make_synthetic_spool
+
+    make_synthetic_spool(
+        src, n_files=n_files, file_duration=FILE_SEC, fs=FS, n_ch=N_CH,
+        noise=0.01,
+    )
+
+
+def _feed(src, r, files_per_round, n_init):
+    from tpudas.testing import make_synthetic_spool
+
+    make_synthetic_spool(
+        src, n_files=files_per_round, file_duration=FILE_SEC, fs=FS,
+        n_ch=N_CH, noise=0.01,
+        start=np.datetime64(T0)
+        + np.timedelta64(
+            int((n_init + (r - 1) * files_per_round) * FILE_SEC * 1e9), "ns"
+        ),
+        prefix=f"raw{r}",
+    )
+
+
+def _drive(src, out, rounds, files_per_round, n_init, health=False,
+           policy=None):
+    """One stateful realtime run under a fresh registry; returns
+    (per-round wall seconds, registry)."""
+    from tpudas.obs.registry import MetricsRegistry, use_registry
+    from tpudas.proc.streaming import run_lowpass_realtime
+    from tpudas.utils.logging import set_log_handler
+
+    events = []
+    set_log_handler(events.append)
+    state = {"fed": 0}
+
+    def sleep(_):
+        # feed round r+1's files only once r rounds have COMPLETED —
+        # keyed on processed rounds, not sleep calls, so the fault
+        # boundary's backoff sleeps cannot shift the feeding schedule
+        # (round boundaries must match the fault-free control exactly
+        # for the byte-identity check to be meaningful)
+        done = sum(1 for e in events if e["event"] == "realtime_round")
+        if state["fed"] < rounds - 1 and state["fed"] < done:
+            state["fed"] += 1
+            _feed(src, state["fed"], files_per_round, n_init)
+
+    reg = MetricsRegistry()
+    try:
+        with use_registry(reg):
+            n = run_lowpass_realtime(
+                source=src, output_folder=out, start_time=T0,
+                output_sample_interval=DT_OUT, edge_buffer=EDGE_SEC,
+                process_patch_size=PATCH_OUT, poll_interval=0.0,
+                sleep_fn=sleep, max_rounds=rounds + 2, stateful=True,
+                health=health, fault_policy=policy,
+            )
+    finally:
+        set_log_handler(None)
+    walls = [
+        e["wall_seconds"] for e in events if e["event"] == "realtime_round"
+    ]
+    return n, walls, reg
+
+
+def _hashes(out):
+    return {
+        f: hashlib.sha256(
+            open(os.path.join(out, f), "rb").read()
+        ).hexdigest()
+        for f in sorted(os.listdir(out))
+        if f.endswith(".h5")
+    }
+
+
+def _boundary_bundle_cost(reads_per_round, folder):
+    """Deterministic replay of ONE steady round's fault-boundary ops
+    (fault points with no plan, empty-ledger exclusion, on_success
+    gauge updates), averaged over many repetitions."""
+    from tpudas.obs.registry import MetricsRegistry, use_registry
+    from tpudas.resilience.faults import (
+        FaultBoundary,
+        RetryPolicy,
+        fault_point,
+    )
+    from tpudas.resilience.quarantine import QuarantineLedger
+
+    os.makedirs(folder, exist_ok=True)
+    ledger = QuarantineLedger(folder)
+    n = 2000
+    with use_registry(MetricsRegistry()):
+        boundary = FaultBoundary(RetryPolicy(), ledger)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            try:
+                fault_point("round.body", poll=1)
+                fault_point("index.update", directory=folder)
+                for _ in range(reads_per_round):
+                    fault_point("spool.read", path="p.h5")
+                fault_point("carry.save", folder=folder)
+                boundary.excluded_now()
+                boundary.on_success()
+            except Exception:  # pragma: no cover - replay never raises
+                raise
+        return (time.perf_counter() - t0) / n
+
+
+def run(out_path, rounds=6, files_per_round=2):
+    import tempfile
+
+    from tpudas.obs.health import read_health
+    from tpudas.resilience.faults import FAULT_SITES, RetryPolicy
+    from tpudas.testing import (
+        FaultPlan,
+        FaultSpec,
+        install_fault_plan,
+        write_corrupt_file,
+    )
+
+    t_bench0 = time.perf_counter()
+    n_init = max(
+        files_per_round, int(np.ceil((PATCH_OUT + 20) * DT_OUT / FILE_SEC))
+    )
+    fast = RetryPolicy(base_delay=0.0, max_delay=0.0, jitter=0.0,
+                       quarantine_after=2)
+    report = {"metric": "fault_boundary", "config": {
+        "fs": FS, "n_ch": N_CH, "dt_out": DT_OUT, "edge_sec": EDGE_SEC,
+        "file_sec": FILE_SEC, "rounds": rounds,
+        "files_per_round": files_per_round,
+    }}
+
+    with tempfile.TemporaryDirectory() as td:
+        # control: fault-free drive
+        src = os.path.join(td, "src_ctrl")
+        out = os.path.join(td, "out_ctrl")
+        _make_src(src, n_init)
+        n_ctrl, walls_ctrl, _ = _drive(
+            src, out, rounds, files_per_round, n_init
+        )
+        control = _hashes(out)
+        steady = sorted(walls_ctrl[1:]) or [0.0]
+
+        # 1) per-site transient fault -> retried, byte-identical
+        specs = {
+            "spool.read": FaultSpec("spool.read", at=3),
+            "index.update": FaultSpec("index.update", at=2),
+            "round.body": FaultSpec("round.body", at=2),
+            "carry.save": FaultSpec("carry.save", at=2),
+        }
+        assert set(specs) == set(FAULT_SITES)
+        injection = {}
+        for site, spec in specs.items():
+            s = os.path.join(td, f"src_{site.replace('.', '_')}")
+            o = os.path.join(td, f"out_{site.replace('.', '_')}")
+            _make_src(s, n_init)
+            plan = FaultPlan(spec)
+            with install_fault_plan(plan):
+                n, _, reg = _drive(
+                    s, o, rounds, files_per_round, n_init, policy=fast
+                )
+            injection[site] = {
+                "fired": bool(plan.fired),
+                "driver_alive": n >= 1,
+                "retries": reg.value("tpudas_stream_retries_total"),
+                "outputs_identical": _hashes(o) == control,
+            }
+        report["fault_injection"] = injection
+
+        # 2) persistently corrupt file -> quarantined, driver alive
+        s = os.path.join(td, "src_quar")
+        o = os.path.join(td, "out_quar")
+        _make_src(s, n_init)
+        write_corrupt_file(os.path.join(s, "raw_9999.h5"))
+        n, _, reg = _drive(
+            s, o, rounds, files_per_round, n_init, health=True,
+            policy=fast,
+        )
+        health = read_health(o) or {}
+        report["quarantine"] = {
+            "driver_alive": n >= 1,
+            "rounds": n,
+            "gauge_quarantined_files": reg.value(
+                "tpudas_stream_quarantined_files"
+            ),
+            "health_quarantined_files": health.get("quarantined_files"),
+            "health_degraded": health.get("degraded"),
+            "ledger_exists": os.path.isfile(
+                os.path.join(o, ".quarantine.json")
+            ),
+        }
+
+        # 3) overhead: deterministic bundle replay vs steady-round floor
+        reads_per_round = 2 * max(files_per_round, 1)  # 2x overcounted
+        bundle_s = _boundary_bundle_cost(
+            reads_per_round, os.path.join(td, "bundle")
+        )
+        floor = min(steady)
+        report["overhead"] = {
+            "steady_round_wall_s": round(floor, 5),
+            "steady_rounds_measured": len(steady),
+            "boundary_bundle_s": round(bundle_s, 8),
+            "reads_per_round_replayed": reads_per_round,
+            "overhead_pct": (
+                round(100.0 * bundle_s / floor, 4) if floor else None
+            ),
+            "note": (
+                "bundle = per-round fault_point checks (no plan) + "
+                "empty-ledger exclusion + on_success gauge updates, "
+                "replayed deterministically; whole-drive A/B is "
+                "noise-bound on shared CPU (see BENCH_pr02 note)"
+            ),
+        }
+
+    report["bench_wall_s"] = round(time.perf_counter() - t_bench0, 2)
+    ok = (
+        all(
+            v["fired"] and v["driver_alive"] and v["outputs_identical"]
+            and v["retries"] >= 1
+            for v in report["fault_injection"].values()
+        )
+        and report["quarantine"]["driver_alive"]
+        and report["quarantine"]["gauge_quarantined_files"] == 1
+        and report["quarantine"]["health_quarantined_files"] == 1
+        and report["quarantine"]["health_degraded"] is True
+        and (report["overhead"]["overhead_pct"] or 100.0) < 1.0
+    )
+    report["accepted"] = ok
+    with open(out_path, "w") as fh:
+        json.dump(report, fh, indent=1)
+        fh.write("\n")
+    print(json.dumps(report))
+    return report, ok
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default=os.path.join(REPO, "BENCH_pr03.json"))
+    ap.add_argument("--rounds", type=int, default=6)
+    ap.add_argument("--files-per-round", type=int, default=2)
+    args = ap.parse_args()
+    _, ok = run(
+        args.out, rounds=args.rounds, files_per_round=args.files_per_round
+    )
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -194,7 +194,8 @@ def _instr_cost_per_round(spans_per_round, reg_ops_per_round, folder):
         "realtime_factor": 100.0, "round_realtime_factor": 100.0,
         "head_lag_seconds": 10.0, "redundant_ratio": 0.0,
         "carry_resume_count": 0, "last_round_wall_seconds": 0.05,
-        "last_error": None,
+        "consecutive_failures": 0, "quarantined_files": 0,
+        "degraded": False, "last_error": None,
     }
     os.makedirs(folder, exist_ok=True)
     sink = []

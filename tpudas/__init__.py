@@ -19,13 +19,15 @@ from tpudas.core.mapping import FrozenDict
 from tpudas.io.spool import spool, BaseSpool, MemorySpool, DirectorySpool
 from tpudas.core import units
 from tpudas import obs
+from tpudas import resilience
 
-__version__ = "0.6.0"
+__version__ = "0.7.0"
 
 __all__ = [
     "Patch",
     "spool",
     "obs",
+    "resilience",
     "BaseSpool",
     "MemorySpool",
     "DirectorySpool",

@@ -78,6 +78,11 @@ class DirectoryIndex:
         self.directory = os.path.abspath(str(directory))
         self._records: dict[str, dict] = {}
         self._loaded_cache = False
+        # {basename: "Type: message"} for files whose scan failed in
+        # the LAST update() — the realtime driver feeds these to the
+        # quarantine ledger (tpudas.resilience) instead of the round
+        # silently shrinking
+        self.scan_errors: dict[str, str] = {}
 
     # cache persistence ------------------------------------------------
     @property
@@ -123,18 +128,27 @@ class DirectoryIndex:
             pass  # read-only data dir: keep the index in memory only
 
     # scanning ---------------------------------------------------------
-    def update(self) -> "DirectoryIndex":
-        """Incrementally rescan the directory; returns self."""
-        from tpudas.io.registry import scan_file
+    def update(self, exclude=()) -> "DirectoryIndex":
+        """Incrementally rescan the directory; returns self.
 
+        ``exclude`` (basenames) skips those files entirely — no stat,
+        no scan, records dropped while excluded.  The realtime driver
+        passes the quarantine set here so a known-bad file stops
+        costing a failed scan every polling round."""
+        from tpudas.io.registry import scan_file
+        from tpudas.resilience.faults import fault_point
+
+        fault_point("index.update", directory=self.directory)
         if not self._loaded_cache:
             self._load_cache()
         if not os.path.isdir(self.directory):
             raise FileNotFoundError(f"no such directory: {self.directory}")
+        exclude = frozenset(exclude)
+        self.scan_errors = {}
         seen = set()
         changed = False
         for name in sorted(os.listdir(self.directory)):
-            if not name.lower().endswith(_SUFFIXES):
+            if not name.lower().endswith(_SUFFIXES) or name in exclude:
                 continue
             path = os.path.join(self.directory, name)
             try:
@@ -150,12 +164,17 @@ class DirectoryIndex:
             fmt = _FORMAT_BY_SUFFIX[os.path.splitext(name.lower())[1]]
             try:
                 info = scan_file(path, format=fmt)[0]
-            except (OSError, ValueError):
+            except (OSError, ValueError, KeyError) as exc:
                 # unreadable / foreign / partially-written file: a STALE
                 # record for it must go too — the file's bytes no longer
                 # match what the record promises (e.g. truncated in
                 # place), and serving it would surface a short read at
-                # window-assembly time
+                # window-assembly time.  The failure is surfaced in
+                # scan_errors so the caller can quarantine repeat
+                # offenders rather than re-paying this scan forever.
+                self.scan_errors[name] = (
+                    f"{type(exc).__name__}: {str(exc)[:200]}"
+                )
                 if rec is not None:
                     del self._records[name]
                     changed = True

@@ -357,7 +357,7 @@ class DirectorySpool(BaseSpool):
     _index_cache: dict[str, DirectoryIndex] = {}
 
     def __init__(self, directory, _index=None, _time=None, _distance=None,
-                 _sort_key="time"):
+                 _sort_key="time", _exclude=frozenset()):
         self.directory = os.path.abspath(str(directory))
         if _index is not None:
             self._index = _index
@@ -370,6 +370,7 @@ class DirectorySpool(BaseSpool):
         self._time = _time
         self._distance = _distance
         self._sort_key = _sort_key
+        self._exclude = frozenset(_exclude)
 
     def _clone(self, **kw):
         args = {
@@ -377,6 +378,7 @@ class DirectorySpool(BaseSpool):
             "_time": self._time,
             "_distance": self._distance,
             "_sort_key": self._sort_key,
+            "_exclude": self._exclude,
         }
         args.update(kw)
         return DirectorySpool(self.directory, **args)
@@ -385,7 +387,7 @@ class DirectorySpool(BaseSpool):
         """Re-scan the directory for new/changed files (incremental)."""
         reg = get_registry()
         t0 = _time.perf_counter()
-        self._index.update()
+        self._index.update(exclude=self._exclude)
         reg.histogram(
             "tpudas_spool_update_seconds",
             "directory index re-scan latency",
@@ -397,6 +399,22 @@ class DirectorySpool(BaseSpool):
 
     def sort(self, key="time"):
         return self._clone(_sort_key=key)
+
+    def exclude(self, names):
+        """A view of this spool without the given basenames — the
+        realtime driver's quarantine hook (tpudas.resilience).  The
+        exclusion applies to the index re-scan (``update`` stops
+        scanning them) AND the served frame (records already indexed
+        are hidden)."""
+        return self._clone(
+            _exclude=self._exclude | frozenset(map(str, names))
+        )
+
+    @property
+    def scan_errors(self) -> dict:
+        """{basename: message} for files whose scan failed in the last
+        ``update()`` (see DirectoryIndex.scan_errors)."""
+        return dict(self._index.scan_errors)
 
     def select(self, time=None, distance=None):
         return self._clone(
@@ -410,6 +428,11 @@ class DirectorySpool(BaseSpool):
         df = self._index.to_dataframe()
         if df.empty:
             return df
+        if self._exclude:
+            keep = ~df["path"].map(
+                lambda p: os.path.basename(str(p)) in self._exclude
+            )
+            df = df[keep]
         if self._sort_key == "time":
             df = df.sort_values("time_min", kind="stable")
         if self._time is not None:
@@ -431,15 +454,26 @@ class DirectorySpool(BaseSpool):
 
     def _read_row(self, row) -> Patch:
         from tpudas.io.registry import read_file
+        from tpudas.resilience.faults import SpoolReadError, fault_point
 
         reg = get_registry()
         t0 = _time.perf_counter()
-        patches = read_file(
-            row["path"],
-            format=row.get("format", "dasdae"),
-            time=self._time,
-            distance=self._distance,
-        )
+        try:
+            fault_point("spool.read", path=row["path"])
+            patches = read_file(
+                row["path"],
+                format=row.get("format", "dasdae"),
+                time=self._time,
+                distance=self._distance,
+            )
+        except Exception as exc:
+            # attribute the failure to the file so the fault boundary
+            # can charge the quarantine ledger (tpudas.resilience)
+            reg.counter(
+                "tpudas_spool_read_errors_total",
+                "file payload reads that raised",
+            ).inc()
+            raise SpoolReadError(row["path"], exc) from exc
         reg.histogram(
             "tpudas_spool_read_seconds",
             "per-file payload read latency (selection applied)",

@@ -42,7 +42,9 @@ __all__ = [
 
 HEALTH_FILENAME = "health.json"
 PROM_FILENAME = "metrics.prom"
-HEALTH_SCHEMA_VERSION = 1
+# v2 (PR 3): degradation fields — consecutive_failures,
+# quarantined_files, degraded (tpudas.resilience)
+HEALTH_SCHEMA_VERSION = 2
 
 # keys every snapshot carries (OBSERVABILITY.md documents types/units);
 # tests schema-check against this
@@ -58,6 +60,9 @@ HEALTH_REQUIRED_KEYS = (
     "redundant_ratio",
     "carry_resume_count",
     "last_round_wall_seconds",
+    "consecutive_failures",
+    "quarantined_files",
+    "degraded",
     "last_error",
 )
 

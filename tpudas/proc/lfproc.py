@@ -51,7 +51,41 @@ from tpudas.proc.naming import get_filename
 from tpudas.utils.logging import log_event
 
 __all__ = ["LFProc", "PallasVerificationError", "check_merge",
-           "schedule_windows", "lowpass_resample"]
+           "resolve_gap_tolerance", "schedule_windows", "lowpass_resample"]
+
+
+_GAP_ALIAS_WARNED = False  # the deprecated spelling warns once per process
+
+
+def resolve_gap_tolerance(correct=None, legacy=None):
+    """One value from the correctly spelled ``data_gap_tolerance`` and
+    the reference's ``data_gap_tolorance`` (lf_das.py:202 — the
+    misspelling IS the reference surface, kept as a deprecated alias).
+    Passing both with different values is an error; using only the
+    legacy spelling warns ``DeprecationWarning`` once per process.
+    Returns None when neither is given."""
+    global _GAP_ALIAS_WARNED
+    if legacy is None:
+        return correct
+    if correct is not None:
+        if float(correct) != float(legacy):
+            raise ValueError(
+                "data_gap_tolerance and its deprecated alias "
+                f"data_gap_tolorance disagree ({correct!r} vs {legacy!r}); "
+                "pass only data_gap_tolerance"
+            )
+        return correct
+    if not _GAP_ALIAS_WARNED:
+        _GAP_ALIAS_WARNED = True
+        import warnings
+
+        warnings.warn(
+            "data_gap_tolorance is the reference's misspelling, kept as "
+            "a deprecated alias; use data_gap_tolerance",
+            DeprecationWarning,
+            stacklevel=3,
+        )
+    return legacy
 
 # first-window cross-check tolerance: the v2 kernel's 3-pass bf16 dot
 # splits land ~1e-5 from the f32 XLA formulation (PERF.md §4) and the
@@ -336,6 +370,15 @@ class LFProc:
         self._mesh = mesh
 
     def update_processing_parameter(self, **kwargs):
+        if "data_gap_tolerance" in kwargs or "data_gap_tolorance" in kwargs:
+            # the parameters dict keeps the reference's key (compat);
+            # the correctly spelled kwarg is the public spelling
+            v = resolve_gap_tolerance(
+                kwargs.pop("data_gap_tolerance", None),
+                kwargs.pop("data_gap_tolorance", None),
+            )
+            if v is not None:
+                kwargs["data_gap_tolorance"] = v
         for key, value in kwargs.items():
             if key not in self._para:
                 print(f"{key} is not default parameter key")

@@ -136,7 +136,10 @@ def save_carry(carry: StreamCarry, folder: str) -> str:
     """Atomically persist the carry beside the output files: one
     ``.npz`` (meta embedded, tmp-then-rename) plus a readable ``.json``
     sidecar.  Returns the npz path."""
+    from tpudas.resilience.faults import fault_point
+
     path = os.path.join(folder, CARRY_FILENAME)
+    fault_point("carry.save", folder=folder)
     with span("stream.carry_save"):
         arrays = {"meta": np.asarray(json.dumps(carry._meta()))}
         for i, b in enumerate(carry.bufs):

@@ -23,6 +23,18 @@ serializes beside the outputs so a crash resumes from O(1) state.
 reference's rewind behavior; joint/mesh/window-DP runs and legacy
 output folders (outputs but no carry) use the rewind path
 automatically.
+
+Fault tolerance (tpudas.resilience): each polling round runs inside a
+per-round fault boundary.  Transient IO failures (an NFS hiccup, a
+file the interrogator is still flushing) are retried with capped
+exponential backoff + deterministic jitter; a file whose read/decode
+keeps failing is quarantined in a ``.quarantine.json`` ledger beside
+the carry and excluded from the spool index (slow-schedule re-probe);
+only genuinely fatal errors — config/programming mistakes, the
+reference's ``on_gap="raise"`` — propagate.  A retried round resumes
+exactly like a crash does: the in-memory carry is dropped and
+re-resolved from disk (reconcile included), so the crash-only
+invariant is untouched.  See RESILIENCE.md.
 """
 
 from __future__ import annotations
@@ -38,8 +50,14 @@ from tpudas.io.spool import spool as make_spool
 from tpudas.obs.health import write_health, write_prom
 from tpudas.obs.registry import get_registry
 from tpudas.obs.trace import span
-from tpudas.proc.lfproc import LFProc
+from tpudas.proc.lfproc import LFProc, resolve_gap_tolerance
 from tpudas.proc.naming import get_filename
+from tpudas.resilience.faults import (
+    FaultBoundary,
+    RetryPolicy,
+    fault_point,
+)
+from tpudas.resilience.quarantine import QuarantineLedger
 from tpudas.utils.logging import log_event
 from tpudas.utils.profiling import Counters
 
@@ -53,15 +71,17 @@ class _EdgeHealth:
     every round.  Enabled by ``TPUDAS_HEALTH=1`` (or the driver's
     ``health=True``); write failures are counted and swallowed."""
 
-    def __init__(self, folder, enabled):
+    def __init__(self, folder, enabled, boundary=None):
         self.folder = folder
         self.enabled = enabled
+        self.boundary = boundary  # FaultBoundary (degradation fields)
         self.carry_resumes = 0
         self.last_error = None
 
     def write(self, counters, rounds, polls, mode, round_rt, head_lag):
         if not self.enabled:
             return
+        b = self.boundary
         write_health(
             self.folder,
             {
@@ -76,7 +96,13 @@ class _EdgeHealth:
                 "redundant_ratio": round(counters.redundant_ratio, 4),
                 "carry_resume_count": self.carry_resumes,
                 "last_round_wall_seconds": round(counters.last_wall, 4),
-                "last_error": self.last_error,
+                "consecutive_failures": 0 if b is None else b.consecutive,
+                "quarantined_files": (
+                    0 if b is None else b.quarantined_count
+                ),
+                "degraded": False if b is None else b.degraded,
+                "last_error": self.last_error
+                or (None if b is None else b.last_error),
             },
         )
         write_prom(self.folder)
@@ -175,6 +201,7 @@ def run_lowpass_realtime(
     on_gap=None,
     filter_order=None,
     data_gap_tolorance=None,
+    data_gap_tolerance=None,
     window_dp=None,
     counters=None,
     mesh=None,
@@ -183,6 +210,8 @@ def run_lowpass_realtime(
     rolling_step=None,
     stateful=None,
     health=None,
+    fault_policy=None,
+    quarantine=True,
 ):
     """Poll ``source`` and keep the low-pass output current.
 
@@ -218,9 +247,25 @@ def run_lowpass_realtime(
     interrogator box can scrape stream liveness without touching the
     process — see tpudas.obs.health and OBSERVABILITY.md.
 
+    ``data_gap_tolerance`` is the correctly spelled form of the
+    reference's ``data_gap_tolorance``; the legacy spelling remains a
+    deprecated alias (warns once) and passing both with different
+    values is an error.
+
+    ``fault_policy`` (a :class:`tpudas.resilience.RetryPolicy`; None =
+    defaults) governs the per-round fault boundary: transient/corrupt
+    round failures are retried with capped exponential backoff instead
+    of killing the driver, repeat-offender files are quarantined (the
+    ``.quarantine.json`` ledger beside the carry; ``quarantine=False``
+    disables the ledger), and only fatal errors propagate.  A retried
+    round resumes exactly like a crash: the in-memory carry is dropped
+    and re-resolved from disk.  See RESILIENCE.md for the taxonomy and
+    the operator runbook.
+
     Returns the number of rounds that processed data. Terminates when a
     poll sees no new files (reference semantics) or after
-    ``max_rounds``.
+    ``max_rounds`` polls (retries consume polls, so a bounded test can
+    never spin forever).
     """
     if rolling_output_folder is None and (
         rolling_window is not None or rolling_step is not None
@@ -234,13 +279,14 @@ def run_lowpass_realtime(
     buff_out = int(np.ceil(edge_buffer / d_t))
     interval = clamp_poll_interval(poll_interval, file_duration, edge_buffer)
     start_time = to_datetime64(start_time)
+    gap_tol = resolve_gap_tolerance(data_gap_tolerance, data_gap_tolorance)
     extra = {
         k: v
         for k, v in (
             ("engine", engine),
             ("on_gap", on_gap),
             ("filter_order", filter_order),
-            ("data_gap_tolorance", data_gap_tolorance),
+            ("data_gap_tolerance", gap_tol),
             ("window_dp", window_dp),
         )
         if v is not None
@@ -248,7 +294,16 @@ def run_lowpass_realtime(
     counters = counters if counters is not None else Counters()
     if health is None:
         health = os.environ.get("TPUDAS_HEALTH", "0") == "1"
-    edge_health = _EdgeHealth(output_folder, bool(health))
+    policy = fault_policy if fault_policy is not None else RetryPolicy()
+    if quarantine:
+        # the ledger lives beside the carry; the folder must exist even
+        # if the first processing round has not created it yet
+        os.makedirs(output_folder, exist_ok=True)
+        ledger = QuarantineLedger(output_folder)
+    else:
+        ledger = None
+    boundary = FaultBoundary(policy, ledger)
+    edge_health = _EdgeHealth(output_folder, bool(health), boundary)
     reg = get_registry()
 
     if stateful is None:
@@ -267,240 +322,312 @@ def run_lowpass_realtime(
     polls = 0
     prev_t2 = None  # previous round's processing head (redundancy metric)
     len_last = None  # spool size at the previous poll (None = no poll yet)
+    round_rt = 0.0  # last round's realtime factor (final health snapshot)
+    head_lag = None
     try:
         while True:
             polls += 1
             reg.counter(
                 "tpudas_stream_polls_total", "source spool polls"
             ).inc()
-            sp = make_spool(source).update()
-            sub = sp.select(distance=distance) if distance is not None else sp
-            n_now = len(sub)
-            if len_last is not None and n_now == len_last:
-                print("No new data was detected. Real-time processing ended successfully.")
-                break
-            if n_now > 0:
-                joint_extra = {}
-                if rolling_output_folder is not None:
-                    from tpudas.proc.joint import JointProc
-
-                    lfp = JointProc(sub, mesh=mesh)
-                    joint_extra = {
-                        k: v
-                        for k, v in (("rolling_window", rolling_window),
-                                     ("rolling_step", rolling_step))
-                        if v is not None
-                    }
-                else:
-                    lfp = LFProc(sub, mesh=mesh)
-                lfp.update_processing_parameter(
-                    output_sample_interval=d_t,
-                    process_patch_size=int(process_patch_size),
-                    edge_buff_size=buff_out,
-                    **extra,
-                    **joint_extra,
+            try:
+                fault_point("round.body", poll=polls)
+                # quarantine exclusion + index update + scan-failure
+                # strikes + slow-schedule probe bookkeeping
+                sp = boundary.begin_round(make_spool(source), source)
+                sub = (
+                    sp.select(distance=distance)
+                    if distance is not None
+                    else sp
                 )
-                lfp.set_output_folder(output_folder, delete_existing=False)
-                if rolling_output_folder is not None:
-                    lfp.set_rolling_output_folder(
-                        rolling_output_folder, delete_existing=False
-                    )
-                rounds += 1
-                print("run number: ", rounds)
-                if stateful and not carry_checked:
-                    # one-time disk resolution: resume a persisted carry,
-                    # or fall back to rewind mode for a legacy folder that
-                    # has outputs but no carry (its resume point is only
-                    # expressible as a rewind)
-                    carry_checked = True
-                    from tpudas.proc.stream import (
-                        carry_matches,
-                        load_carry,
-                        reconcile_outputs,
-                    )
+                n_now = len(sub)
+                if (
+                    len_last is not None
+                    and n_now == len_last
+                    and boundary.consecutive == 0
+                ):
+                    print("No new data was detected. Real-time processing ended successfully.")
+                    break
+                if n_now > 0:
+                    joint_extra = {}
+                    if rolling_output_folder is not None:
+                        from tpudas.proc.joint import JointProc
 
-                    carry = load_carry(output_folder)
-                    if carry is not None and not carry_matches(
-                        carry, lfp, start_time
-                    ):
-                        raise ValueError(
-                            "persisted stream carry in "
-                            f"{output_folder} was produced under a "
-                            "different start_time or processing "
-                            "parameters; delete it (or the folder) to "
-                            "change configuration"
+                        lfp = JointProc(sub, mesh=mesh)
+                        joint_extra = {
+                            k: v
+                            for k, v in (("rolling_window", rolling_window),
+                                         ("rolling_step", rolling_step))
+                            if v is not None
+                        }
+                    else:
+                        lfp = LFProc(sub, mesh=mesh)
+                    lfp.update_processing_parameter(
+                        output_sample_interval=d_t,
+                        process_patch_size=int(process_patch_size),
+                        edge_buff_size=buff_out,
+                        **extra,
+                        **joint_extra,
+                    )
+                    lfp.set_output_folder(
+                        output_folder, delete_existing=False
+                    )
+                    if rolling_output_folder is not None:
+                        lfp.set_rolling_output_folder(
+                            rolling_output_folder, delete_existing=False
                         )
-                    if carry is not None:
-                        # patch_size only shapes chunking — honor the
-                        # live setting rather than the persisted one
-                        carry.patch_out = int(process_patch_size)
-                        reconcile_outputs(output_folder, carry)
-                        log_event("stream_resume", emitted=carry.emitted)
-                        edge_health.carry_resumes += 1
-                        reg.counter(
-                            "tpudas_stream_carry_resumes_total",
-                            "rounds resumed from a persisted stream carry",
-                        ).inc()
-                    else:
-                        try:
-                            lfp.get_last_processed_time()
-                            has_outputs = True
-                        except Exception:
-                            has_outputs = False
-                        if has_outputs:
-                            stateful = False
-                            print(
-                                "Existing output folder has no stream "
-                                "carry; continuing in rewind mode"
+                    # committed to `rounds` only when the attempt
+                    # completes — a failed attempt is a retry, not a
+                    # processed round
+                    rnd = rounds + 1
+                    print("run number: ", rnd)
+                    if stateful and not carry_checked:
+                        # one-time disk resolution: resume a persisted
+                        # carry, or fall back to rewind mode for a legacy
+                        # folder that has outputs but no carry (its resume
+                        # point is only expressible as a rewind)
+                        carry_checked = True
+                        from tpudas.proc.stream import (
+                            carry_matches,
+                            load_carry,
+                            reconcile_outputs,
+                        )
+
+                        carry = load_carry(output_folder)
+                        if carry is not None and not carry_matches(
+                            carry, lfp, start_time
+                        ):
+                            raise ValueError(
+                                "persisted stream carry in "
+                                f"{output_folder} was produced under a "
+                                "different start_time or processing "
+                                "parameters; delete it (or the folder) to "
+                                "change configuration"
                             )
-                            log_event("stream_legacy_rewind")
+                        if carry is not None:
+                            # patch_size only shapes chunking — honor the
+                            # live setting rather than the persisted one
+                            carry.patch_out = int(process_patch_size)
+                            reconcile_outputs(output_folder, carry)
+                            log_event("stream_resume", emitted=carry.emitted)
+                            edge_health.carry_resumes += 1
+                            reg.counter(
+                                "tpudas_stream_carry_resumes_total",
+                                "rounds resumed from a persisted stream "
+                                "carry",
+                            ).inc()
                         else:
-                            carry = lfp.open_stream(start_time)
-                            # persist BEFORE the first outputs: a crash
-                            # mid-round-1 then still reads as a stateful
-                            # folder (reconcile + resume) instead of
-                            # degrading to rewind mode forever via the
-                            # legacy heuristic above
-                            from tpudas.proc.stream import save_carry
+                            try:
+                                lfp.get_last_processed_time()
+                                has_outputs = True
+                            except (FileNotFoundError, IndexError) as exc:
+                                # the two EXPECTED "no outputs yet"
+                                # signals (virgin/empty folder); a real
+                                # IO error must not be misread as "no
+                                # outputs" — it propagates to the fault
+                                # boundary instead
+                                has_outputs = False
+                                log_event(
+                                    "stream_no_prior_outputs",
+                                    reason=(
+                                        f"{type(exc).__name__}: "
+                                        f"{str(exc)[:120]}"
+                                    ),
+                                )
+                            if has_outputs:
+                                stateful = False
+                                print(
+                                    "Existing output folder has no stream "
+                                    "carry; continuing in rewind mode"
+                                )
+                                log_event("stream_legacy_rewind")
+                            else:
+                                carry = lfp.open_stream(start_time)
+                                # persist BEFORE the first outputs: a
+                                # crash mid-round-1 then still reads as a
+                                # stateful folder (reconcile + resume)
+                                # instead of degrading to rewind mode
+                                # forever via the legacy heuristic above
+                                from tpudas.proc.stream import save_carry
 
-                            save_carry(carry, output_folder)
-                # newest timestamp from the index — no file data is read
-                contents = sub.get_contents()
-                t2 = np.datetime64(contents["time_max"].max())
-                redundant = 0.0
-                if stateful:
-                    # carried state: only NEW samples are read/filtered
-                    t1 = (
-                        np.datetime64(int(carry.next_ingest_ns), "ns")
-                        if carry.next_ingest_ns is not None
-                        else start_time
-                    )
-                    data_sec, ch_samples = _covered_workload(contents, t1, t2)
-                    with span(
-                        "stream.round", mode="stateful", round=rounds
-                    ), counters.measure(int(ch_samples), data_sec):
-                        lfp.process_stream_increment(carry, t2)
-                    from tpudas.proc.stream import save_carry
+                                save_carry(carry, output_folder)
+                    # newest timestamp from the index — no file data is
+                    # read
+                    contents = sub.get_contents()
+                    t2 = np.datetime64(contents["time_max"].max())
+                    redundant = 0.0
+                    if stateful:
+                        # carried state: only NEW samples are read/filtered
+                        t1 = (
+                            np.datetime64(int(carry.next_ingest_ns), "ns")
+                            if carry.next_ingest_ns is not None
+                            else start_time
+                        )
+                        data_sec, ch_samples = _covered_workload(
+                            contents, t1, t2
+                        )
+                        with span(
+                            "stream.round", mode="stateful", round=rnd
+                        ), counters.measure(int(ch_samples), data_sec):
+                            lfp.process_stream_increment(carry, t2)
+                        from tpudas.proc.stream import save_carry
 
-                    # saved AFTER the outputs: the carry is never ahead of
-                    # the files (crash-only; resume reconciles the rest)
-                    save_carry(carry, output_folder)
-                else:
-                    resumed_stateful = False
-                    if not rewind_wrote:
-                        # a persisted carry means the folder head was
-                        # written by the stateful mode; this rewind write
-                        # breaks the carry's no-newer-outputs invariant,
-                        # so invalidate it — and CONTINUE from the folder
-                        # head (the t_last resume below) rather than
-                        # reprocessing from start_time, leaving every
-                        # stateful-era product file untouched
-                        rewind_wrote = True
-                        from tpudas.proc.stream import discard_carry
-
-                        if discard_carry(output_folder):
-                            resumed_stateful = True
-                            print(
-                                "Removed stale stream carry; rewind mode "
-                                "continues from the folder head"
-                            )
-                    if not processed_once and not resumed_stateful:
-                        t1 = start_time
+                        # saved AFTER the outputs: the carry is never ahead
+                        # of the files (crash-only; resume reconciles the
+                        # rest)
+                        save_carry(carry, output_folder)
                     else:
-                        try:
-                            t_last = lfp.get_last_processed_time()
-                        except IndexError:
-                            # a prior round completed without emitting output
-                            # (stream still shorter than the edge trim) — no
-                            # checkpoint yet, retry from the very start
-                            t_last = None
-                        if t_last is None:
+                        resumed_stateful = False
+                        if not rewind_wrote:
+                            # a persisted carry means the folder head was
+                            # written by the stateful mode; this rewind
+                            # write breaks the carry's no-newer-outputs
+                            # invariant, so invalidate it — and CONTINUE
+                            # from the folder head (the t_last resume
+                            # below) rather than reprocessing from
+                            # start_time, leaving every stateful-era
+                            # product file untouched
+                            rewind_wrote = True
+                            from tpudas.proc.stream import discard_carry
+
+                            if discard_carry(output_folder):
+                                resumed_stateful = True
+                                print(
+                                    "Removed stale stream carry; rewind "
+                                    "mode continues from the folder head"
+                                )
+                        if not processed_once and not resumed_stateful:
                             t1 = start_time
                         else:
-                            # rewind (ceil(edge/dt) - 1) output steps, exactly
-                            # on the output grid — ns precision so fractional
-                            # d_t stays seam-free (the resumed run's first
-                            # emitted sample is then t_last + d_t)
-                            rewind_sec = (math.ceil(edge_buffer / d_t) - 1) * d_t
-                            t1 = t_last - to_timedelta64(rewind_sec)
-                    data_sec, ch_samples = _covered_workload(contents, t1, t2)
-                    if prev_t2 is not None and t1 < prev_t2:
-                        # full-rate samples re-read solely to rebuild the
-                        # filter's transient state (what stateful mode
-                        # eliminates)
-                        _, redundant = _covered_workload(
-                            contents, t1, min(prev_t2, t2)
+                            try:
+                                t_last = lfp.get_last_processed_time()
+                            except IndexError:
+                                # a prior round completed without emitting
+                                # output (stream still shorter than the
+                                # edge trim) — no checkpoint yet, retry
+                                # from the very start
+                                t_last = None
+                            if t_last is None:
+                                t1 = start_time
+                            else:
+                                # rewind (ceil(edge/dt) - 1) output steps,
+                                # exactly on the output grid — ns precision
+                                # so fractional d_t stays seam-free (the
+                                # resumed run's first emitted sample is
+                                # then t_last + d_t)
+                                rewind_sec = (
+                                    math.ceil(edge_buffer / d_t) - 1
+                                ) * d_t
+                                t1 = t_last - to_timedelta64(rewind_sec)
+                        data_sec, ch_samples = _covered_workload(
+                            contents, t1, t2
                         )
-                        counters.add_redundant(int(redundant))
-                    with span(
-                        "stream.round", mode="rewind", round=rounds
-                    ), counters.measure(int(ch_samples), data_sec):
-                        lfp.process_time_range(t1, t2)
-                prev_t2 = t2
-                round_rt = (
-                    data_sec / counters.last_wall
-                    if counters.last_wall
-                    else 0.0
-                )
-                mode_str = "stateful" if stateful else "rewind"
-                log_event(
-                    "realtime_round",
-                    round=rounds,
-                    upto=str(t2),
-                    mode=mode_str,
-                    data_seconds=round(data_sec, 3),
-                    redundant_samples=int(redundant),
-                    wall_seconds=round(counters.last_wall, 4),
-                    realtime_factor=round(round_rt, 2),
-                    engine=lfp.parameters["engine"],
-                    engine_counts=dict(lfp.engine_counts),
-                    native_windows=lfp.native_windows,
-                )
-                reg.counter(
-                    "tpudas_stream_rounds_total",
-                    "processing rounds completed",
-                    labelnames=("mode",),
-                ).inc(mode=mode_str)
-                reg.histogram(
-                    "tpudas_stream_round_seconds",
-                    "per-round measured processing wall time",
-                ).observe(counters.last_wall)
-                reg.gauge(
-                    "tpudas_stream_realtime_factor",
-                    "last round's data-seconds per wall-second",
-                ).set(round_rt)
-                reg.gauge(
-                    "tpudas_stream_redundant_ratio",
-                    "cumulative fraction of channel-samples re-read to "
-                    "rebuild filter state",
-                ).set(counters.redundant_ratio)
-                # stateful head lag is O(1) off the carry; the rewind
-                # fallback rescans the output index, so only pay it
-                # when an operator is actually scraping health
-                head_lag = (
-                    _head_lag_seconds(
-                        t2, lfp, carry if stateful else None
+                        if prev_t2 is not None and t1 < prev_t2:
+                            # full-rate samples re-read solely to rebuild
+                            # the filter's transient state (what stateful
+                            # mode eliminates)
+                            _, redundant = _covered_workload(
+                                contents, t1, min(prev_t2, t2)
+                            )
+                            counters.add_redundant(int(redundant))
+                        with span(
+                            "stream.round", mode="rewind", round=rnd
+                        ), counters.measure(int(ch_samples), data_sec):
+                            lfp.process_time_range(t1, t2)
+                    prev_t2 = t2
+                    rounds = rnd
+                    round_rt = (
+                        data_sec / counters.last_wall
+                        if counters.last_wall
+                        else 0.0
                     )
-                    if (stateful or edge_health.enabled)
-                    else None
-                )
-                if head_lag is not None:
+                    mode_str = "stateful" if stateful else "rewind"
+                    log_event(
+                        "realtime_round",
+                        round=rnd,
+                        upto=str(t2),
+                        mode=mode_str,
+                        data_seconds=round(data_sec, 3),
+                        redundant_samples=int(redundant),
+                        wall_seconds=round(counters.last_wall, 4),
+                        realtime_factor=round(round_rt, 2),
+                        engine=lfp.parameters["engine"],
+                        engine_counts=dict(lfp.engine_counts),
+                        native_windows=lfp.native_windows,
+                    )
+                    reg.counter(
+                        "tpudas_stream_rounds_total",
+                        "processing rounds completed",
+                        labelnames=("mode",),
+                    ).inc(mode=mode_str)
+                    reg.histogram(
+                        "tpudas_stream_round_seconds",
+                        "per-round measured processing wall time",
+                    ).observe(counters.last_wall)
                     reg.gauge(
-                        "tpudas_stream_head_lag_seconds",
-                        "stream-seconds between the fiber head and the "
-                        "newest emitted output",
-                    ).set(head_lag)
+                        "tpudas_stream_realtime_factor",
+                        "last round's data-seconds per wall-second",
+                    ).set(round_rt)
+                    reg.gauge(
+                        "tpudas_stream_redundant_ratio",
+                        "cumulative fraction of channel-samples re-read to "
+                        "rebuild filter state",
+                    ).set(counters.redundant_ratio)
+                    # stateful head lag is O(1) off the carry; the rewind
+                    # fallback rescans the output index, so only pay it
+                    # when an operator is actually scraping health
+                    head_lag = (
+                        _head_lag_seconds(
+                            t2, lfp, carry if stateful else None
+                        )
+                        if (stateful or edge_health.enabled)
+                        else None
+                    )
+                    if head_lag is not None:
+                        reg.gauge(
+                            "tpudas_stream_head_lag_seconds",
+                            "stream-seconds between the fiber head and the "
+                            "newest emitted output",
+                        ).set(head_lag)
+                    boundary.on_success()
+                    edge_health.write(
+                        counters, rnd, polls, mode_str, round_rt, head_lag
+                    )
+                    if on_round is not None:
+                        on_round(rnd, lfp)
+                    processed_once = True
+                else:
+                    boundary.on_success()
+                # every poll (including an empty first one) sets the
+                # growth baseline: the next no-growth poll terminates
+                # (reference semantics — the loop ends when the spool
+                # stops growing, low_pass_dascore_edge.ipynb:205-207)
+                len_last = n_now
+            except Exception as exc:
+                decision = boundary.on_failure(exc)
+                if decision.propagate:
+                    raise
+                # crash-equivalent retry: drop the in-memory carry and
+                # re-resolve it from disk on the next attempt — the
+                # resume path reconciles any partial outputs exactly as
+                # a process restart would, so a retried round and a
+                # crash-restart are the same code path
+                if stateful:
+                    carry = None
+                    carry_checked = False
                 edge_health.write(
-                    counters, rounds, polls, mode_str, round_rt, head_lag
+                    counters, rounds, polls,
+                    "stateful" if stateful else "rewind", 0.0, None,
                 )
-                if on_round is not None:
-                    on_round(rounds, lfp)
-                processed_once = True
-            # every poll (including an empty first one) sets the growth
-            # baseline: the next no-growth poll terminates (reference
-            # semantics — the loop ends when the spool stops growing,
-            # low_pass_dascore_edge.ipynb:205-207)
-            len_last = n_now
+                if max_rounds is not None and polls >= max_rounds:
+                    break
+                with span(
+                    "stream.retry",
+                    kind=decision.kind,
+                    attempt=boundary.consecutive,
+                ):
+                    sleep_fn(decision.delay)
+                continue
             if max_rounds is not None and polls >= max_rounds:
                 break
             sleep_fn(interval)
@@ -517,6 +644,13 @@ def run_lowpass_realtime(
             "stateful" if stateful else "rewind", 0.0, None,
         )
         raise
+    # final snapshot on clean termination: quarantine/degradation state
+    # from the LAST poll (a file can be quarantined by the very poll
+    # that terminates the loop) must be visible to the operator
+    edge_health.write(
+        counters, rounds, polls,
+        "stateful" if stateful else "rewind", round_rt, head_lag,
+    )
     return rounds
 
 
@@ -539,6 +673,8 @@ def run_rolling_realtime(
     sleep_fn=_time.sleep,
     engine=None,
     mesh=None,
+    fault_policy=None,
+    quarantine=True,
 ):
     """Poll ``source`` and rolling-mean each NEW patch (stateless per
     file — rolling_mean_dascore_edge.ipynb:209-221). Returns rounds
@@ -549,6 +685,13 @@ def run_rolling_realtime(
     whenever the chunk is shape-uniform and ``engine`` is not a host
     engine ("numpy"/"host" forces the per-patch host path);
     non-uniform chunks fall back to the per-patch device path.
+
+    Rounds run inside the same per-round fault boundary as
+    :func:`run_lowpass_realtime` (``fault_policy`` /
+    ``quarantine`` — see RESILIENCE.md): transient/corrupt failures
+    are retried with backoff, repeat-offender files quarantined.
+    Patches written before a mid-round failure are in the ``processed``
+    set already, so a retry resumes at the first unwritten patch.
     """
     import os
 
@@ -562,6 +705,9 @@ def run_rolling_realtime(
     interval = float(poll_interval) if poll_interval is not None else float(
         file_duration
     )
+    policy = fault_policy if fault_policy is not None else RetryPolicy()
+    ledger = QuarantineLedger(output_folder) if quarantine else None
+    boundary = FaultBoundary(policy, ledger)
     initial_run = True
     rounds = 0
     polls = 0
@@ -571,65 +717,90 @@ def run_rolling_realtime(
     processed: set = set()
     while True:
         polls += 1
-        sp = make_spool(source).sort("time").update()
-        sub = sp.select(distance=distance) if distance is not None else sp
-        contents = sub.get_contents()
-        keys = [
-            (np.datetime64(a, "ns"), np.datetime64(b, "ns"))
-            for a, b in zip(contents["time_min"], contents["time_max"])
-        ]
-        fresh = [j for j, k in enumerate(keys) if k not in processed]
-        if not initial_run and not fresh:
-            print("No new data was detected. Real-time data processing ended successfully.")
-            break
-        if fresh:
-            rounds += 1
-            print("run number: ", rounds)
+        try:
+            fault_point("round.body", poll=polls)
+            sp = boundary.begin_round(
+                make_spool(source).sort("time"), source
+            )
+            sub = (
+                sp.select(distance=distance) if distance is not None else sp
+            )
+            contents = sub.get_contents()
+            keys = [
+                (np.datetime64(a, "ns"), np.datetime64(b, "ns"))
+                for a, b in zip(contents["time_min"], contents["time_max"])
+            ]
+            fresh = [j for j, k in enumerate(keys) if k not in processed]
+            if not initial_run and not fresh and boundary.consecutive == 0:
+                print("No new data was detected. Real-time data processing ended successfully.")
+                break
+            if fresh:
+                rnd = rounds + 1
+                print("run number: ", rnd)
 
-            def write_out(j, out):
-                out = out.new(data=np.asarray(out.data) * scale)
-                fname = get_filename(
-                    out.attrs["time_min"], out.attrs["time_max"]
-                )
-                out.io.write(os.path.join(output_folder, fname), "dasdae")
-                processed.add(keys[j])
-
-            # bounded chunks: memory stays O(chunk), outputs are
-            # written as soon as they are computed
-            for c0 in range(0, len(fresh), _ROLLING_BATCH_CHUNK):
-                chunk = fresh[c0 : c0 + _ROLLING_BATCH_CHUNK]
-                outs = None
-                if (
-                    mesh is not None
-                    and engine not in ("numpy", "host")
-                    and len(chunk) > 1
-                ):
-                    from tpudas.ops.rolling import (
-                        rolling_mean_patches_batched,
+                def write_out(j, out):
+                    out = out.new(data=np.asarray(out.data) * scale)
+                    fname = get_filename(
+                        out.attrs["time_min"], out.attrs["time_max"]
                     )
-
-                    patches = [sub[j] for j in chunk]
-                    outs = rolling_mean_patches_batched(
-                        mesh, patches, window, step
+                    out.io.write(
+                        os.path.join(output_folder, fname), "dasdae"
                     )
-                    if outs is not None:
-                        log_event(
-                            "rolling_batched",
-                            patches=len(chunk),
-                            mesh=dict(mesh.shape),
+                    processed.add(keys[j])
+
+                # bounded chunks: memory stays O(chunk), outputs are
+                # written as soon as they are computed
+                for c0 in range(0, len(fresh), _ROLLING_BATCH_CHUNK):
+                    chunk = fresh[c0 : c0 + _ROLLING_BATCH_CHUNK]
+                    outs = None
+                    if (
+                        mesh is not None
+                        and engine not in ("numpy", "host")
+                        and len(chunk) > 1
+                    ):
+                        from tpudas.ops.rolling import (
+                            rolling_mean_patches_batched,
                         )
-                        for j, out in zip(chunk, outs):
-                            write_out(j, out)
-                if outs is None:
-                    for j in chunk:
-                        print("working on patch ", j)
-                        write_out(
-                            j,
-                            sub[j]
-                            .rolling(time=window, step=step, engine=engine)
-                            .mean(),
+
+                        patches = [sub[j] for j in chunk]
+                        outs = rolling_mean_patches_batched(
+                            mesh, patches, window, step
                         )
-        initial_run = False
+                        if outs is not None:
+                            log_event(
+                                "rolling_batched",
+                                patches=len(chunk),
+                                mesh=dict(mesh.shape),
+                            )
+                            for j, out in zip(chunk, outs):
+                                write_out(j, out)
+                    if outs is None:
+                        for j in chunk:
+                            print("working on patch ", j)
+                            write_out(
+                                j,
+                                sub[j]
+                                .rolling(
+                                    time=window, step=step, engine=engine
+                                )
+                                .mean(),
+                            )
+                rounds = rnd
+            boundary.on_success()
+            initial_run = False
+        except Exception as exc:
+            decision = boundary.on_failure(exc)
+            if decision.propagate:
+                raise
+            if max_rounds is not None and polls >= max_rounds:
+                break
+            with span(
+                "stream.retry",
+                kind=decision.kind,
+                attempt=boundary.consecutive,
+            ):
+                sleep_fn(decision.delay)
+            continue
         if max_rounds is not None and polls >= max_rounds:
             break
         sleep_fn(interval)

@@ -1,0 +1,51 @@
+"""tpudas.resilience: fault isolation for the unattended edge driver.
+
+The paper's deployment target is an operator-less box at the
+interrogator; PR 1 made the realtime loop crash-only (kill it anywhere,
+the next run resumes seam-free) and PR 2 made it observable.  This
+package closes the remaining gap: a crash should not be the ANSWER to
+every fault.  Three pieces:
+
+- :mod:`tpudas.resilience.faults` — failure taxonomy
+  (transient / corrupt / fatal), deterministic capped-exponential
+  retry/backoff (:class:`RetryPolicy`), the per-round
+  :class:`FaultBoundary` the realtime drivers run their rounds inside,
+  and the deterministic fault-injection harness (:class:`FaultPlan`)
+  that lets tier-1 tests exercise every degradation path;
+- :mod:`tpudas.resilience.quarantine` — the bad-file ledger
+  (``.quarantine.json`` beside the stream carry): a file that fails to
+  read/decode N times is excluded from the spool index and retried on
+  a slow schedule in case the interrogator finishes writing it late.
+
+See RESILIENCE.md for the failure taxonomy, retry policy, ledger
+format, and the operator runbook for ``degraded`` health states.
+"""
+
+from tpudas.resilience.faults import (
+    FAULT_SITES,
+    FaultBoundary,
+    FaultPlan,
+    FaultSpec,
+    RetryPolicy,
+    SpoolReadError,
+    TransientFaultError,
+    classify_failure,
+    fault_point,
+    install_fault_plan,
+)
+from tpudas.resilience.quarantine import QUARANTINE_FILENAME, QuarantineLedger
+
+__all__ = [
+    "FAULT_SITES",
+    "FaultBoundary",
+    "FaultPlan",
+    "FaultSpec",
+    "RetryPolicy",
+    "SpoolReadError",
+    "TransientFaultError",
+    "classify_failure",
+    "fault_point",
+    "install_fault_plan",
+    "QUARANTINE_FILENAME",
+    "QuarantineLedger",
+]

@@ -1,10 +1,22 @@
-"""Synthetic DAS data for tests, examples and benchmarks.
+"""Synthetic DAS data and the fault-injection harness for tests,
+examples and benchmarks.
 
 The reference ships no fixtures beyond its impulse probe (SURVEY.md §4);
 tpudas provides a deterministic interrogator simulator: contiguous
 dasdae files of a (time x distance) strain-rate stream containing a
 known low-frequency component (recoverable after low-pass + decimate),
 high-frequency interference (must be rejected), and noise.
+
+Fault injection (re-exported from :mod:`tpudas.resilience.faults` —
+the hooks live there so production IO modules never import this
+module): build a :class:`FaultPlan` of :class:`FaultSpec` entries
+(raise / truncate / delay at the named :data:`FAULT_SITES` — spool
+read, index update, round body, carry save) and scope it with
+:func:`install_fault_plan`; every degradation path in the realtime
+drivers is then exercisable deterministically.
+:func:`write_corrupt_file` fabricates the classic bad input — a file
+with valid HDF5 magic and garbage after it (a truncated interrogator
+flush).
 """
 
 from __future__ import annotations
@@ -16,10 +28,44 @@ import numpy as np
 from tpudas.core.patch import Patch
 from tpudas.core.timeutils import to_datetime64
 from tpudas.io.registry import write_patch
+from tpudas.resilience.faults import (  # noqa: F401 - re-exported harness
+    FAULT_SITES,
+    FaultPlan,
+    FaultSpec,
+    TransientFaultError,
+    install_fault_plan,
+)
 
-__all__ = ["synthetic_patch", "make_synthetic_spool", "lowfreq_truth"]
+__all__ = [
+    "synthetic_patch",
+    "make_synthetic_spool",
+    "lowfreq_truth",
+    "write_corrupt_file",
+    "FAULT_SITES",
+    "FaultPlan",
+    "FaultSpec",
+    "TransientFaultError",
+    "install_fault_plan",
+]
 
 DEFAULT_T0 = "2023-03-22T00:00:00"
+
+# the HDF5 signature — a half-written interrogator file usually has a
+# valid header and garbage (or nothing) after it
+_HDF5_MAGIC = b"\x89HDF\r\n\x1a\n"
+
+
+def write_corrupt_file(path, nbytes=512, seed=0) -> str:
+    """A deterministic un-decodable DAS file: valid HDF5 magic (so the
+    suffix and sniffer both say "dasdae"), garbage payload (so the scan
+    fails) — the shape of a file the interrogator died mid-flush on.
+    Returns ``path``."""
+    rng = np.random.default_rng(seed)
+    body = rng.integers(0, 256, size=max(int(nbytes) - 8, 0), dtype=np.uint8)
+    with open(path, "wb") as fh:
+        fh.write(_HDF5_MAGIC)
+        fh.write(body.tobytes())
+    return str(path)
 
 
 def _time_axis(t0, n, fs):
