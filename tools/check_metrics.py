@@ -35,6 +35,27 @@ SCAN_ROOTS = ("tpudas", "tools")
 SCAN_FILES = ("bench.py",)
 CATALOG = "OBSERVABILITY.md"
 
+# Load-bearing instrumentation: operator dashboards and the serve
+# bench (tools/serve_bench.py) read these by name, so deleting or
+# renaming one must fail the lint — being well-named and catalogued is
+# not enough, the metric has to EXIST in the sources.
+REQUIRED_METRICS = (
+    "tpudas_serve_requests_total",
+    "tpudas_serve_request_seconds",
+    "tpudas_serve_shed_total",
+    "tpudas_serve_inflight",
+    "tpudas_serve_cache_hits_total",
+    "tpudas_serve_cache_misses_total",
+    "tpudas_serve_tile_loads_total",
+    "tpudas_serve_singleflight_coalesced_total",
+    "tpudas_serve_queries_total",
+    "tpudas_serve_fallback_reads_total",
+    "tpudas_serve_pyramid_append_seconds",
+    "tpudas_serve_pyramid_appended_samples_total",
+    "tpudas_serve_pyramid_errors_total",
+)
+REQUIRED_SPANS = ("serve.request", "serve.query", "serve.pyramid_append")
+
 
 def iter_source_files(repo: str = REPO):
     for root_name in SCAN_ROOTS:
@@ -57,9 +78,12 @@ def collect_names(text: str):
     return metrics, spans
 
 
-def lint(sources: dict, catalog_text: str):
+def lint(sources: dict, catalog_text: str, require: bool = False):
     """``sources``: {path: text}.  Returns a list of violation
-    strings (empty = clean)."""
+    strings (empty = clean).  ``require=True`` (the full-repo run in
+    :func:`main`) additionally enforces that every REQUIRED_METRICS /
+    REQUIRED_SPANS name is actually emitted somewhere in ``sources``;
+    partial-source unit tests leave it off."""
     problems = []
     seen_metrics = set()
     seen_spans = set()
@@ -85,6 +109,19 @@ def lint(sources: dict, catalog_text: str):
                 f"span name {name!r} is not catalogued in {CATALOG} "
                 "(add it to the span-name table)"
             )
+    for name in REQUIRED_METRICS if require else ():
+        if name not in seen_metrics:
+            problems.append(
+                f"required metric {name!r} is not emitted anywhere in "
+                "the scanned sources (operator dashboards and "
+                "tools/serve_bench.py read it by name)"
+            )
+    for name in REQUIRED_SPANS if require else ():
+        if name not in seen_spans:
+            problems.append(
+                f"required span {name!r} is not emitted anywhere in "
+                "the scanned sources"
+            )
     return problems
 
 
@@ -100,7 +137,7 @@ def main(argv=None) -> int:
     for path in iter_source_files(repo):
         with open(path) as fh:
             sources[os.path.relpath(path, repo)] = fh.read()
-    problems = lint(sources, catalog_text)
+    problems = lint(sources, catalog_text, require=True)
     for p in problems:
         print(p)
     if not problems:

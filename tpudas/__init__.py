@@ -20,14 +20,16 @@ from tpudas.io.spool import spool, BaseSpool, MemorySpool, DirectorySpool
 from tpudas.core import units
 from tpudas import obs
 from tpudas import resilience
+from tpudas import serve
 
-__version__ = "0.7.0"
+__version__ = "0.8.0"
 
 __all__ = [
     "Patch",
     "spool",
     "obs",
     "resilience",
+    "serve",
     "BaseSpool",
     "MemorySpool",
     "DirectorySpool",

@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import json
 import os
-import tempfile
 
 import numpy as np
 import pandas as pd
@@ -99,31 +98,50 @@ class DirectoryIndex:
     CACHE_VERSION = 3
 
     def _load_cache(self):
+        """Load the persisted index, falling back to the ``.prev``
+        double buffer when the primary is torn/corrupt — a reader (the
+        serve query engine) may race a writer round on a non-atomic
+        network mount, exactly the health.json scenario.  A primary
+        that parses but carries a foreign version is authoritative: the
+        whole cache is discarded (no ``.prev`` fallback — stale-version
+        records must not resurrect)."""
         self._loaded_cache = True
-        try:
-            with open(self.cache_path) as fh:
-                raw = json.load(fh)
+        for path in (self.cache_path, self.cache_path + ".prev"):
+            try:
+                with open(path) as fh:
+                    raw = json.load(fh)
+            except FileNotFoundError:
+                continue
+            except (OSError, ValueError):
+                # torn/corrupt snapshot: try the double buffer
+                continue
             if raw.get("version") != self.CACHE_VERSION:
                 self._records = {}
                 return
-            self._records = {
-                k: _record_from_json(v) for k, v in raw.get("files", {}).items()
-            }
-        except (OSError, ValueError, KeyError):
-            self._records = {}
+            try:
+                self._records = {
+                    k: _record_from_json(v)
+                    for k, v in raw.get("files", {}).items()
+                }
+                return
+            except (ValueError, KeyError, TypeError):
+                continue
+        self._records = {}
 
     def _save_cache(self):
         payload = {
             "version": self.CACHE_VERSION,
             "files": {k: _record_to_json(v) for k, v in self._records.items()},
         }
+        from tpudas.utils.atomicio import atomic_write_text
+
         try:
-            fd, tmp = tempfile.mkstemp(
-                dir=self.directory, prefix=".tpudas_index.", suffix=".tmp"
-            )
-            with os.fdopen(fd, "w") as fh:
-                json.dump(payload, fh)
-            os.replace(tmp, self.cache_path)
+            # rename-not-copy double buffer (the obs.health pattern):
+            # the outgoing good snapshot survives as .prev for readers
+            # racing this save on mounts where rename is not atomic
+            if os.path.isfile(self.cache_path):
+                os.replace(self.cache_path, self.cache_path + ".prev")
+            atomic_write_text(self.cache_path, json.dumps(payload))
         except OSError:
             pass  # read-only data dir: keep the index in memory only
 
@@ -197,6 +215,32 @@ class DirectoryIndex:
         if not self._records:
             self.update()
         return self
+
+    def time_range_records(self, t_lo=None, t_hi=None) -> list:
+        """Index records whose time span overlaps ``[t_lo, t_hi]``
+        (datetime64 bounds; ``None`` = unbounded), sorted by
+        ``time_min`` — straight off the in-memory/persisted records,
+        NO directory rescan.  The serve query engine's full-resolution
+        fallback uses this instead of rebuilding a contents frame per
+        request; call :meth:`update` (or :meth:`ensure`) first when
+        freshness matters.  Returns copies — callers cannot corrupt the
+        index."""
+        if not self._loaded_cache:
+            self._load_cache()
+        lo = None if t_lo is None else np.datetime64(t_lo, "ns")
+        hi = None if t_hi is None else np.datetime64(t_hi, "ns")
+        out = []
+        for rec in self._records.values():
+            r_lo, r_hi = rec.get("time_min"), rec.get("time_max")
+            if r_lo is None or r_hi is None:
+                continue
+            if lo is not None and np.datetime64(r_hi, "ns") < lo:
+                continue
+            if hi is not None and np.datetime64(r_lo, "ns") > hi:
+                continue
+            out.append(dict(rec))
+        out.sort(key=lambda r: np.datetime64(r["time_min"], "ns"))
+        return out
 
     def to_dataframe(self) -> pd.DataFrame:
         if not self._records:

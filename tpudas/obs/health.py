@@ -28,6 +28,7 @@ import os
 import time
 
 from tpudas.obs.registry import get_registry
+from tpudas.utils.atomicio import atomic_write_text as _atomic_write_text
 
 __all__ = [
     "HEALTH_FILENAME",
@@ -65,17 +66,6 @@ HEALTH_REQUIRED_KEYS = (
     "degraded",
     "last_error",
 )
-
-
-def _atomic_write_text(path: str, text: str) -> None:
-    """tmp + rename: readers never see a partial file.  Deliberately
-    no fsync — durability across power loss is not worth milliseconds
-    per round for a snapshot that is rewritten every round; the .prev
-    double-buffer covers the corrupt-primary case."""
-    tmp = path + ".tmp"
-    with open(tmp, "w") as fh:
-        fh.write(text)
-    os.replace(tmp, path)
 
 
 def validate_health(payload: dict) -> dict:

@@ -90,10 +90,27 @@ def _host_rolling(data, w, s, op):
         ssum = c[pv + 1] - c[pv + 1 - w]
         out[valid] = ssum / w if op == "mean" else ssum
     else:
+        # vectorized trailing-window extrema via a strided view:
+        # windows[j] = x[j : j + w], so position p maps to window
+        # p - (w - 1).  The view is copy-free but the position gather
+        # is not, so reduce in bounded batches (~16M elements of
+        # float64 at a time) — dense positions with a large window
+        # must not materialize O(positions * w * channels) at once.
+        # NaN propagates exactly as the old per-position loop did.
         fn = np.max if op == "max" else np.min
-        for k, p in enumerate(positions):
-            if p >= w - 1:
-                out[k] = fn(x[p + 1 - w : p + 1], axis=0)
+        valid = positions >= w - 1
+        pv = positions[valid] - (w - 1)
+        if pv.size:
+            windows = np.lib.stride_tricks.sliding_window_view(
+                x, w, axis=0
+            )
+            row_elems = w * int(np.prod(x.shape[1:], dtype=np.int64))
+            batch = max(int(16_000_000 // max(row_elems, 1)), 1)
+            reduced = np.empty((pv.size,) + x.shape[1:], np.float64)
+            for b0 in range(0, pv.size, batch):
+                sel = pv[b0 : b0 + batch]
+                reduced[b0 : b0 + len(sel)] = fn(windows[sel], axis=-1)
+            out[valid] = reduced
     return out
 
 

@@ -282,6 +282,13 @@ class LFProc:
         # and propagates.
         self._pallas_ok = True
         self._pallas_proven = set()
+        # emission listener: called with every output patch AFTER its
+        # HDF5 write (the realtime driver feeds the serve-side tile
+        # pyramid from here, so the per-round append never re-reads
+        # the files it just watched being written).  Listener failures
+        # are counted and swallowed — a read-side consumer must not
+        # take down the write path.
+        self._on_emit = None
         # cross-check the first Pallas window of each shape against the
         # XLA formulation (off: TPUDAS_PALLAS_VERIFY=0) — a Mosaic
         # miscompile returning silently wrong numbers must not ship
@@ -1398,6 +1405,19 @@ class LFProc:
         result.io.write(os.path.join(self._output_folder, filename), "dasdae")
         t_write = time.perf_counter() - t_w0
         self.timings["write_s"] += t_write
+        if self._on_emit is not None:
+            try:
+                self._on_emit(result)
+            except Exception as exc:
+                get_registry().counter(
+                    "tpudas_emit_listener_errors_total",
+                    "output-emission listener callbacks that raised "
+                    "(swallowed)",
+                ).inc()
+                log_event(
+                    "emit_listener_failed",
+                    error=f"{type(exc).__name__}: {str(exc)[:200]}",
+                )
         log_event(
             "window_timing",
             device_s=round(t_dev, 5),

@@ -108,6 +108,52 @@ class _EdgeHealth:
         write_prom(self.folder)
 
 
+def _append_pyramid(output_folder, rnd, emitted, state) -> None:
+    """Per-round serve-side hook: cascade this round's new output rows
+    into the :mod:`tpudas.serve.tiles` pyramid beside the carry.
+
+    ``emitted`` holds the round's output patches captured in memory at
+    their write site (``LFProc._on_emit``), so the steady-state append
+    costs tile IO only — no index rescan, no re-reading files this
+    process just wrote.  ``state["store"]`` carries the open store
+    across rounds (a stat-gated refresh per round, not a re-parse);
+    it is dropped to None on any failure — exactly the carry's
+    crash-equivalent discipline — and any discontinuity (fresh
+    folder, crashed append) falls back to the file-backed sync, so a
+    retried or crash-resumed round needs no pyramid bookkeeping: disk
+    is the only durable state.  A pyramid failure is counted and
+    swallowed: the read side degrades (the query engine falls back to
+    full-resolution files), the write side must not."""
+    from tpudas.serve.tiles import append_patches
+
+    reg = get_registry()
+    t0 = _time.perf_counter()
+    try:
+        with span("serve.pyramid_append", round=rnd):
+            appended, state["store"] = append_patches(
+                output_folder, emitted, store=state.get("store")
+            )
+    except Exception as exc:
+        state["store"] = None  # crash-equivalent: re-resolve from disk
+        reg.counter(
+            "tpudas_serve_pyramid_errors_total",
+            "per-round pyramid appends that failed (swallowed; the "
+            "query engine falls back to full-resolution files)",
+        ).inc()
+        log_event(
+            "pyramid_append_failed",
+            round=rnd,
+            error=f"{type(exc).__name__}: {str(exc)[:200]}",
+        )
+        return
+    reg.histogram(
+        "tpudas_serve_pyramid_append_seconds",
+        "per-round tile-pyramid append wall time",
+    ).observe(_time.perf_counter() - t0)
+    if appended:
+        log_event("pyramid_append", round=rnd, rows=int(appended))
+
+
 def _head_lag_seconds(t2, lfp, carry) -> float | None:
     """Stream-seconds between the fiber head (newest indexed input,
     ``t2``) and the newest emitted output — the operator's "how far
@@ -212,6 +258,7 @@ def run_lowpass_realtime(
     health=None,
     fault_policy=None,
     quarantine=True,
+    pyramid=None,
 ):
     """Poll ``source`` and keep the low-pass output current.
 
@@ -251,6 +298,17 @@ def run_lowpass_realtime(
     reference's ``data_gap_tolorance``; the legacy spelling remains a
     deprecated alias (warns once) and passing both with different
     values is an error.
+
+    ``pyramid`` (default: ``TPUDAS_PYRAMID=1``) keeps the
+    :mod:`tpudas.serve.tiles` multi-resolution tile pyramid in
+    ``output_folder`` current: after every processing round the rows
+    newer than the pyramid head are appended and the coarser
+    mean/min/max levels cascaded, so the serve stack
+    (:mod:`tpudas.serve`) answers window queries at any zoom without
+    re-reading output files.  The append is crash-only like the carry
+    (manifest written after its tiles) and failures are counted and
+    swallowed — the pyramid must never take down the stream that
+    feeds it.
 
     ``fault_policy`` (a :class:`tpudas.resilience.RetryPolicy`; None =
     defaults) governs the per-round fault boundary: transient/corrupt
@@ -305,6 +363,9 @@ def run_lowpass_realtime(
     boundary = FaultBoundary(policy, ledger)
     edge_health = _EdgeHealth(output_folder, bool(health), boundary)
     reg = get_registry()
+    if pyramid is None:
+        pyramid = os.environ.get("TPUDAS_PYRAMID", "0") == "1"
+    pyramid = bool(pyramid)
 
     if stateful is None:
         stateful = os.environ.get("TPUDAS_STREAM_STATEFUL", "1") != "0"
@@ -314,6 +375,7 @@ def run_lowpass_realtime(
     carry = None  # the cross-round filter state (stateful mode)
     carry_checked = False  # disk/legacy resolution happens once
     rewind_wrote = False  # first rewind write invalidates any carry
+    pyr_state = {"store": None}  # cross-round open tile store (pyramid)
 
     processed_once = False  # first PROCESSING round always starts at
     # start_time, however many empty polls precede it (a pre-existing
@@ -349,6 +411,7 @@ def run_lowpass_realtime(
                     print("No new data was detected. Real-time processing ended successfully.")
                     break
                 if n_now > 0:
+                    t_body = _time.perf_counter()
                     joint_extra = {}
                     if rolling_output_folder is not None:
                         from tpudas.proc.joint import JointProc
@@ -372,6 +435,11 @@ def run_lowpass_realtime(
                     lfp.set_output_folder(
                         output_folder, delete_existing=False
                     )
+                    emitted_patches = []
+                    if pyramid:
+                        # capture the round's output blocks at their
+                        # write site for the in-memory pyramid append
+                        lfp._on_emit = emitted_patches.append
                     if rolling_output_folder is not None:
                         lfp.set_rolling_output_folder(
                             rolling_output_folder, delete_existing=False
@@ -589,10 +657,20 @@ def run_lowpass_realtime(
                             "stream-seconds between the fiber head and the "
                             "newest emitted output",
                         ).set(head_lag)
+                    if pyramid:
+                        _append_pyramid(
+                            output_folder, rnd, emitted_patches,
+                            pyr_state,
+                        )
                     boundary.on_success()
                     edge_health.write(
                         counters, rnd, polls, mode_str, round_rt, head_lag
                     )
+                    reg.histogram(
+                        "tpudas_stream_round_body_seconds",
+                        "full processing-round wall time (index update "
+                        "through health write, pyramid append included)",
+                    ).observe(_time.perf_counter() - t_body)
                     if on_round is not None:
                         on_round(rnd, lfp)
                     processed_once = True
@@ -615,6 +693,7 @@ def run_lowpass_realtime(
                 if stateful:
                     carry = None
                     carry_checked = False
+                pyr_state["store"] = None
                 edge_health.write(
                     counters, rounds, polls,
                     "stateful" if stateful else "rewind", 0.0, None,

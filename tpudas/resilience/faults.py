@@ -33,7 +33,11 @@ block).  Production code marks its fault sites with
 - ``"index.update"`` — the directory index re-scan (tpudas/io/index.py);
 - ``"round.body"`` — top of each realtime processing round
   (tpudas/proc/streaming.py);
-- ``"carry.save"`` — the stream-carry persist (tpudas/proc/stream.py).
+- ``"carry.save"`` — the stream-carry persist (tpudas/proc/stream.py);
+- ``"serve.tile_read"`` — per-tile pyramid read (tpudas/serve/tiles.py);
+- ``"serve.queue_full"`` — the HTTP admission gate (tpudas/serve/http.py):
+  an injected fault here reads as "gate saturated", so load-shed paths
+  are testable without racing real threads.
 """
 
 from __future__ import annotations
@@ -325,7 +329,14 @@ class FaultBoundary:
 # ---------------------------------------------------------------------------
 # deterministic fault injection
 
-FAULT_SITES = ("spool.read", "index.update", "round.body", "carry.save")
+FAULT_SITES = (
+    "spool.read",
+    "index.update",
+    "round.body",
+    "carry.save",
+    "serve.tile_read",
+    "serve.queue_full",
+)
 
 _ACTIONS = ("raise", "truncate", "delay")
 

@@ -1,0 +1,47 @@
+"""tpudas.serve — the read side of the streaming stack.
+
+The pipeline produces low-frequency output continuously
+(tpudas.proc.streaming); this package makes that output *queryable* at
+interactive latency without re-reading raw files:
+
+- :mod:`tpudas.serve.tiles` — an incremental multi-resolution pyramid
+  (mean/min/max) over the processed output, appended round-by-round
+  beside the stream carry, crash-only like the carry itself;
+- :mod:`tpudas.serve.query` — time x distance window reads that pick
+  the coarsest pyramid level satisfying a requested resolution, backed
+  by an LRU tile cache with single-flight request coalescing and a
+  full-resolution file fallback for windows older than the pyramid;
+- :mod:`tpudas.serve.http` — a zero-dependency threaded HTTP server
+  (``/query``, ``/waterfall``, ``/healthz``, ``/metrics``) with a
+  bounded admission gate that sheds load with 503 + Retry-After.
+
+See SERVING.md for the pyramid format, endpoint reference and the
+operator runbook.
+"""
+
+from tpudas.serve.query import QueryEngine, QueryResult
+from tpudas.serve.tiles import TileStore, sync_pyramid
+
+__all__ = [
+    "QueryEngine",
+    "QueryResult",
+    "TileStore",
+    "sync_pyramid",
+    "serve_forever",
+    "start_server",
+]
+
+
+def start_server(*args, **kwargs):
+    """Lazy re-export of :func:`tpudas.serve.http.start_server` (keeps
+    ``import tpudas.serve`` free of the http.server import)."""
+    from tpudas.serve.http import start_server as _start
+
+    return _start(*args, **kwargs)
+
+
+def serve_forever(*args, **kwargs):
+    """Lazy re-export of :func:`tpudas.serve.http.serve_forever`."""
+    from tpudas.serve.http import serve_forever as _serve
+
+    return _serve(*args, **kwargs)

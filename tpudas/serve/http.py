@@ -1,0 +1,421 @@
+"""Zero-dependency HTTP server over one processed-output folder.
+
+``ThreadingHTTPServer`` (stdlib, thread per connection) fronted by a
+**bounded admission gate**: at most ``max_inflight`` data-plane
+requests execute at once, and a request that arrives with the gate
+full is shed IMMEDIATELY with ``503 + Retry-After`` instead of
+queueing behind a backlog it can only deepen (graceful degradation,
+the tpudas.resilience posture).  Control-plane endpoints
+(``/healthz``, ``/metrics``) bypass the gate — an operator must be
+able to see a saturated server's health *because* it is saturated.
+
+Endpoints (all GET):
+
+- ``/query``     — windowed array read (``t0``/``t1`` ISO-8601 or ns
+  ints, optional ``d0``/``d1`` distance bounds, ``resolution`` s/sample
+  or ``max_samples``, ``agg`` mean|min|max, ``format`` npy|json).
+- ``/waterfall`` — downsampled raster tile: same window params plus
+  ``max_px`` (time-axis pixel budget, default 1024); picks the pyramid
+  level from the budget and adds symmetric 95th-percentile color
+  limits in ``X-Tpudas-Clim-*`` headers.
+- ``/healthz``   — the stream's last good ``health.json`` snapshot
+  (``tpudas.obs.health.read_health`` — the file stays the crash-safe
+  source of truth; this is its live read path).
+- ``/metrics``   — the LIVE process registry in Prometheus text
+  exposition (the ``metrics.prom`` file snapshot remains for the
+  node-exporter textfile collector).
+
+``npy`` responses carry provenance headers (``X-Tpudas-Level``,
+``X-Tpudas-Step-Ns``, ``X-Tpudas-Source``, ``X-Tpudas-T0-Ns``, ...);
+``json`` responses embed the same fields (NaN rows serialize as
+``null``).  See SERVING.md for the endpoint reference and runbook.
+
+Operator entry point::
+
+    python -m tpudas.serve.http <output_folder> --port 8000
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from tpudas.core.timeutils import to_datetime64
+from tpudas.obs.health import read_health
+from tpudas.obs.registry import get_registry
+from tpudas.obs.trace import span
+from tpudas.resilience.faults import TransientFaultError, fault_point
+from tpudas.serve.query import QueryEngine
+from tpudas.utils.logging import log_event
+
+__all__ = ["DASServer", "start_server", "serve_forever"]
+
+_DEFAULT_MAX_INFLIGHT = 8
+_DATA_ENDPOINTS = ("/query", "/waterfall")
+
+
+class _AdmissionGate:
+    """Bounded concurrent-request gate with immediate shedding."""
+
+    def __init__(self, limit: int):
+        self.limit = max(int(limit), 1)
+        self._sem = threading.BoundedSemaphore(self.limit)
+        self._lock = threading.Lock()
+        self.in_use = 0
+
+    def try_enter(self) -> bool:
+        try:
+            # deterministic saturation for tests: an injected fault at
+            # this site reads as "gate full"
+            fault_point("serve.queue_full")
+        except TransientFaultError:
+            return False
+        if not self._sem.acquire(blocking=False):
+            return False
+        with self._lock:
+            self.in_use += 1
+            depth = self.in_use
+        get_registry().gauge(
+            "tpudas_serve_inflight",
+            "data-plane requests currently executing",
+        ).set(depth)
+        return True
+
+    def leave(self) -> None:
+        with self._lock:
+            self.in_use -= 1
+            depth = self.in_use
+        self._sem.release()
+        get_registry().gauge(
+            "tpudas_serve_inflight",
+            "data-plane requests currently executing",
+        ).set(depth)
+
+
+def _parse_time(raw: str):
+    """ISO-8601 string or integer nanoseconds."""
+    s = str(raw).strip()
+    if s.lstrip("-").isdigit():
+        return np.datetime64(int(s), "ns")
+    return to_datetime64(s)
+
+
+def _params(query: str) -> dict:
+    return {
+        k: v[-1] for k, v in urllib.parse.parse_qs(query).items()
+    }
+
+
+def _json_safe(data: np.ndarray):
+    """Nested lists with NaN -> None (JSON has no NaN)."""
+    out = []
+    for row in np.asarray(data, dtype=np.float64):
+        out.append(
+            [None if not np.isfinite(v) else float(v) for v in row]
+        )
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "tpudas-serve/1"
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing ------------------------------------------------------
+    def log_message(self, fmt, *args):  # stdlib stderr chatter -> JSONL
+        log_event("serve_access", line=(fmt % args)[:200])
+
+    def _send(self, status: int, body: bytes, content_type: str,
+              headers=()):
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers:
+            self.send_header(k, str(v))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, status: int, payload: dict, headers=()):
+        body = (json.dumps(payload, indent=1) + "\n").encode()
+        self._send(status, body, "application/json", headers)
+
+    # -- routing -------------------------------------------------------
+    def do_GET(self):  # noqa: N802 - stdlib handler contract
+        parts = urllib.parse.urlsplit(self.path)
+        endpoint = parts.path.rstrip("/") or "/"
+        reg = get_registry()
+        t_start = time.perf_counter()
+        status = 500
+        gated = endpoint in _DATA_ENDPOINTS
+        if gated and not self.server.gate.try_enter():
+            reg.counter(
+                "tpudas_serve_shed_total",
+                "data-plane requests shed with 503 (admission gate "
+                "full)",
+            ).inc()
+            self._send_json(
+                503,
+                {"error": "server saturated, retry later"},
+                headers=(("Retry-After", "1"),),
+            )
+            self._account(reg, endpoint, 503, t_start)
+            return
+        try:
+            with span("serve.request", endpoint=endpoint):
+                status = self._dispatch(endpoint, _params(parts.query))
+        except ValueError as exc:
+            status = 400
+            self._send_json(400, {"error": str(exc)[:300]})
+        except Exception as exc:
+            status = 500
+            reg.counter(
+                "tpudas_serve_errors_total",
+                "requests that failed with an internal error",
+                labelnames=("endpoint",),
+            ).inc(endpoint=endpoint)
+            log_event(
+                "serve_request_failed",
+                endpoint=endpoint,
+                error=f"{type(exc).__name__}: {str(exc)[:300]}",
+            )
+            self._send_json(
+                500, {"error": f"{type(exc).__name__}: {str(exc)[:300]}"}
+            )
+        finally:
+            if gated:
+                self.server.gate.leave()
+            self._account(reg, endpoint, status, t_start)
+
+    def _account(self, reg, endpoint, status, t_start):
+        reg.counter(
+            "tpudas_serve_requests_total",
+            "HTTP requests served, by endpoint and status",
+            labelnames=("endpoint", "status"),
+        ).inc(endpoint=endpoint, status=status)
+        reg.histogram(
+            "tpudas_serve_request_seconds",
+            "request latency by endpoint",
+            labelnames=("endpoint",),
+        ).observe(time.perf_counter() - t_start, endpoint=endpoint)
+
+    def _dispatch(self, endpoint: str, params: dict) -> int:
+        if endpoint == "/healthz":
+            return self._healthz()
+        if endpoint == "/metrics":
+            return self._metrics()
+        if endpoint == "/query":
+            return self._query(params, waterfall=False)
+        if endpoint == "/waterfall":
+            return self._query(params, waterfall=True)
+        self._send_json(404, {"error": f"unknown endpoint {endpoint!r}"})
+        return 404
+
+    # -- control plane -------------------------------------------------
+    def _healthz(self) -> int:
+        payload = read_health(self.server.folder)
+        if payload is None:
+            self._send_json(
+                503,
+                {"status": "unknown",
+                 "detail": "no health snapshot yet (is the stream "
+                           "running with TPUDAS_HEALTH=1?)"},
+            )
+            return 503
+        body = dict(payload)
+        body["status"] = "degraded" if payload.get("degraded") else "ok"
+        self._send_json(200, body)
+        return 200
+
+    def _metrics(self) -> int:
+        text = get_registry().to_prometheus()
+        self._send(
+            200, text.encode(), "text/plain; version=0.0.4; charset=utf-8"
+        )
+        return 200
+
+    # -- data plane ----------------------------------------------------
+    def _query(self, params: dict, waterfall: bool) -> int:
+        if "t0" not in params or "t1" not in params:
+            raise ValueError("t0 and t1 query parameters are required")
+        t0 = _parse_time(params["t0"])
+        t1 = _parse_time(params["t1"])
+        dist = None
+        if "d0" in params or "d1" in params:
+            dist = (
+                float(params["d0"]) if "d0" in params else None,
+                float(params["d1"]) if "d1" in params else None,
+            )
+        agg = params.get("agg", "mean")
+        if waterfall:
+            max_samples = int(params.get("max_px", 1024))
+            resolution = None
+        else:
+            max_samples = (
+                int(params["max_samples"]) if "max_samples" in params
+                else None
+            )
+            resolution = (
+                float(params["resolution"]) if "resolution" in params
+                else None
+            )
+        result = self.server.engine.query(
+            t0, t1, distance=dist, resolution=resolution,
+            max_samples=max_samples, agg=agg,
+        )
+        headers = [
+            ("X-Tpudas-Level", result.level),
+            ("X-Tpudas-Step-Ns", result.step_ns),
+            ("X-Tpudas-Agg", result.agg),
+            ("X-Tpudas-Source", result.source),
+            ("X-Tpudas-Samples", result.n_samples),
+            ("X-Tpudas-Channels", result.distance.size),
+        ]
+        if result.n_samples:
+            headers.append(
+                ("X-Tpudas-T0-Ns",
+                 int(result.times[0].astype("datetime64[ns]")
+                     .astype(np.int64)))
+            )
+        if waterfall:
+            from tpudas.viz.waterfall import _symmetric_clip
+
+            lo, hi = _symmetric_clip(result.data)
+            headers += [
+                ("X-Tpudas-Clim-Lo", repr(float(lo))),
+                ("X-Tpudas-Clim-Hi", repr(float(hi))),
+            ]
+        if params.get("format", "npy") == "json":
+            self._send_json(
+                200,
+                {
+                    "times_ns": [
+                        int(t) for t in
+                        result.times.astype("datetime64[ns]")
+                        .astype(np.int64)
+                    ],
+                    "distance": [float(d) for d in result.distance],
+                    "data": _json_safe(result.data),
+                    "level": result.level,
+                    "step_ns": result.step_ns,
+                    "agg": result.agg,
+                    "source": result.source,
+                },
+                headers=headers,
+            )
+            return 200
+        buf = io.BytesIO()
+        np.save(buf, np.ascontiguousarray(result.data))
+        self._send(200, buf.getvalue(), "application/x-npy", headers)
+        return 200
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, addr, folder, engine, gate):
+        self.folder = str(folder)
+        self.engine = engine
+        self.gate = gate
+        super().__init__(addr, _Handler)
+
+
+class DASServer:
+    """Lifecycle wrapper: background thread + context manager.
+
+    ``port=0`` binds an ephemeral port (tests); :attr:`base_url` gives
+    the bound address either way.
+    """
+
+    def __init__(self, folder, host="127.0.0.1", port=0,
+                 max_inflight=_DEFAULT_MAX_INFLIGHT, cache_tiles=256,
+                 engine=None):
+        self.folder = str(folder)
+        self.query_engine = QueryEngine(
+            self.folder, cache_tiles=cache_tiles, engine=engine
+        )
+        self._httpd = _Server(
+            (host, int(port)), self.folder, self.query_engine,
+            _AdmissionGate(max_inflight),
+        )
+        self._thread = None
+
+    @property
+    def address(self):
+        return self._httpd.server_address[:2]
+
+    @property
+    def base_url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def start(self) -> "DASServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="tpudas-serve",
+            daemon=True,
+        )
+        self._thread.start()
+        log_event("serve_started", url=self.base_url, folder=self.folder)
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def __enter__(self) -> "DASServer":
+        return self.start()
+
+    def __exit__(self, *exc_info):
+        self.stop()
+        return False
+
+
+def start_server(folder, **kwargs) -> DASServer:
+    """Start a :class:`DASServer` on a background thread; returns it
+    (use as a context manager or call ``.stop()``)."""
+    return DASServer(folder, **kwargs).start()
+
+
+def serve_forever(folder, host="0.0.0.0", port=8000, **kwargs) -> None:
+    """Blocking operator entry point (Ctrl-C to stop)."""
+    server = DASServer(folder, host=host, port=port, **kwargs)
+    print(f"tpudas.serve listening on {server.base_url} over {folder}")
+    try:
+        server._httpd.serve_forever()
+    except KeyboardInterrupt:
+        print("shutting down")
+    finally:
+        server._httpd.server_close()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Serve processed DAS output over HTTP "
+                    "(/query /waterfall /healthz /metrics)"
+    )
+    ap.add_argument("folder", help="processed output folder")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--max-inflight", type=int,
+                    default=_DEFAULT_MAX_INFLIGHT)
+    ap.add_argument("--cache-tiles", type=int, default=256)
+    args = ap.parse_args(argv)
+    serve_forever(
+        args.folder, host=args.host, port=args.port,
+        max_inflight=args.max_inflight, cache_tiles=args.cache_tiles,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

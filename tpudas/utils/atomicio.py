@@ -1,0 +1,33 @@
+"""Atomic file-write primitives shared by the snapshot writers.
+
+One home for the tmp-then-``os.replace`` discipline that
+``health.json`` / ``metrics.prom`` (tpudas.obs.health), the tile
+pyramid's manifest/tails (tpudas.serve.tiles), and the directory-index
+cache (tpudas.io.index) all rely on: readers never see a partial
+file.  Deliberately no fsync — these are snapshots rewritten every
+round; durability across power loss is not worth milliseconds per
+round, and each caller keeps a ``.prev`` double buffer for the
+corrupt-primary case.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["atomic_write_text", "atomic_write_bytes"]
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    """Write ``text`` to ``path`` via tmp + rename."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write(text)
+    os.replace(tmp, path)
+
+
+def atomic_write_bytes(path: str, payload: bytes) -> None:
+    """Write ``payload`` to ``path`` via tmp + rename."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        fh.write(payload)
+    os.replace(tmp, path)

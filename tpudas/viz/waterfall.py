@@ -103,10 +103,49 @@ def waterfall_plot(
     plt.show()
 
 
-def patch_waterfall(patch, scale=None, ax=None, cmap="seismic", show=False):
+def _pyramid_block(patch, pyramid, max_px):
+    """(data, times, dists) for the patch's window read from the tile
+    pyramid at the coarsest level satisfying the ``max_px`` time-axis
+    budget, or ``None`` when the pyramid does not exist / does not
+    cover the window (caller falls back to the full-resolution patch
+    data)."""
+    from tpudas.serve.query import QueryEngine
+
+    engine = (
+        pyramid
+        if isinstance(pyramid, QueryEngine)
+        else QueryEngine(str(pyramid))
+    )
+    if not engine.has_pyramid():
+        # no pyramid: bail BEFORE query() would fall back to re-reading
+        # the window's full-resolution files we already hold as `patch`
+        return None
+    times = patch.coords["time"]
+    dists = np.asarray(patch.coords["distance"], dtype=np.float64)
+    result = engine.query(
+        times[0],
+        times[-1],
+        distance=(float(dists.min()), float(dists.max())),
+        max_samples=int(max_px),
+    )
+    if result.n_samples == 0 or result.source not in ("tiles", "mixed"):
+        return None
+    return result.data, result.times, result.distance
+
+
+def patch_waterfall(patch, scale=None, ax=None, cmap="seismic", show=False,
+                    pyramid=None, max_px=1024):
     """Waterfall of a Patch: time on x (real datetimes), distance on y,
     symmetric color limits. ``scale`` (scalar) clips at
-    ``scale * max|data|``; a (lo, hi) pair sets limits directly."""
+    ``scale * max|data|``; a (lo, hi) pair sets limits directly.
+
+    ``pyramid`` (an output folder path or a
+    :class:`tpudas.serve.query.QueryEngine`) rasters windows wider than
+    ``max_px`` time samples from the multi-resolution tile pyramid
+    instead of materializing the full-resolution block — the plot is
+    O(pixels), not O(window).  With no pyramid (or a window the pyramid
+    does not cover) the full-resolution path runs unchanged, and below
+    the budget the output is identical with or without ``pyramid``."""
     import matplotlib.dates as mdates
     import matplotlib.pyplot as plt
 
@@ -114,6 +153,16 @@ def patch_waterfall(patch, scale=None, ax=None, cmap="seismic", show=False):
     tax = patch.axis_of("time")
     if tax != 0:
         data = data.T
+    times = patch.coords["time"]
+    dists = patch.coords["distance"]
+    if (
+        pyramid is not None
+        and max_px is not None
+        and data.shape[0] > int(max_px)
+    ):
+        block = _pyramid_block(patch, pyramid, max_px)
+        if block is not None:
+            data, times, dists = block
     finite = np.abs(data[np.isfinite(data)])
     vmax = float(finite.max()) if finite.size else 1.0
     if scale is None:
@@ -125,8 +174,6 @@ def patch_waterfall(patch, scale=None, ax=None, cmap="seismic", show=False):
 
     if ax is None:
         _, ax = plt.subplots(figsize=(12, 8))
-    times = patch.coords["time"]
-    dists = patch.coords["distance"]
     # a real time extent (matplotlib date floats), not sample counts
     t_lo, t_hi = (
         mdates.date2num(np.datetime64(times[0], "us").item()),
