@@ -54,6 +54,8 @@ def _payload(**over):
         "consecutive_failures": 0,
         "quarantined_files": 0,
         "degraded": False,
+        "integrity_fallbacks": 0,
+        "resource_degraded": False,
         "last_error": None,
     }
     base.update(over)
@@ -296,7 +298,7 @@ class TestHealth:
         assert path == str(tmp_path / HEALTH_FILENAME)
         got = read_health(str(tmp_path))
         assert got["rounds"] == 3
-        assert got["schema"] == 2
+        assert got["schema"] == 3
         assert got["written_at"] > 0
         # no stray tmp file left behind
         assert sorted(os.listdir(tmp_path)) == [HEALTH_FILENAME]
@@ -323,7 +325,7 @@ class TestHealth:
         assert read_health(str(tmp_path)) is None
 
     def test_validate_schema(self):
-        validate_health({**_payload(), "schema": 2, "written_at": 0.0})
+        validate_health({**_payload(), "schema": 3, "written_at": 0.0})
         with pytest.raises(ValueError):
             validate_health(
                 {**_payload(), "schema": 99, "written_at": 0.0}

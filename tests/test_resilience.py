@@ -105,9 +105,24 @@ def _hashes(out):
 
 class TestClassify:
     def test_taxonomy(self):
+        import errno
+
         assert classify_failure(OSError("nfs hiccup")) == "transient"
         assert classify_failure(TransientFaultError("x")) == "transient"
         assert classify_failure(TimeoutError("t")) == "transient"
+        # disk-full on the OUTPUT side is its own kind (PR 5): retried
+        # with extra patience + non-essential writers shed
+        assert classify_failure(
+            OSError(errno.ENOSPC, "no space left on device")
+        ) == "resource"
+        assert classify_failure(
+            OSError(errno.EDQUOT, "quota exceeded")
+        ) == "resource"
+        # ...but ENOSPC surfacing through a SOURCE file read stays
+        # file-attributed (transient, the interrogator side)
+        assert classify_failure(
+            SpoolReadError("/d/f.h5", OSError(errno.ENOSPC, "full"))
+        ) == "transient"
         # file-attributed: OSError inside -> transient, decode -> corrupt
         assert classify_failure(
             SpoolReadError("/d/f.h5", OSError("short read"))
@@ -268,12 +283,15 @@ class TestTransientRetryByteIdentical:
     fault-free run (stateful carry mode, the default)."""
 
     # carry.save at=2 is the nastiest case: the save AFTER round 1's
-    # outputs fails, so the retry must reconcile the partial emission
+    # outputs fails, so the retry must reconcile the partial emission;
+    # fs.write_enospc at=2 (PR 5) fails a checksummed atomic state
+    # write mid-round — the round retries like any transient IO
     SPECS = {
         "spool.read": FaultSpec("spool.read", at=1),
         "index.update": FaultSpec("index.update", at=1),
         "round.body": FaultSpec("round.body", at=1),
         "carry.save": FaultSpec("carry.save", at=2),
+        "fs.write_enospc": FaultSpec("fs.write_enospc", at=2),
     }
 
     @pytest.fixture(scope="class")
@@ -321,6 +339,12 @@ class TestCrashResumeEquivalence:
         ),
         "round.body": FaultSpec("round.body", at=2, exc=KeyboardInterrupt),
         "carry.save": FaultSpec("carry.save", at=2, exc=KeyboardInterrupt),
+        # PR 5: die INSIDE an atomic state write (the checksummed
+        # carry/health/index path) — the stamp + .prev ladder must
+        # make the resume seam-free anyway
+        "fs.write_enospc": FaultSpec(
+            "fs.write_enospc", at=2, exc=KeyboardInterrupt
+        ),
     }
 
     @pytest.fixture(scope="class")

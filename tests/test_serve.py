@@ -522,7 +522,8 @@ class TestHTTP:
             "head_lag_seconds": 1.0, "redundant_ratio": 0.0,
             "carry_resume_count": 0, "last_round_wall_seconds": 0.1,
             "consecutive_failures": 0, "quarantined_files": 0,
-            "degraded": False, "last_error": None,
+            "degraded": False, "integrity_fallbacks": 0,
+            "resource_degraded": False, "last_error": None,
         })
         with start_server(out) as srv:
             r = urllib.request.urlopen(srv.base_url + "/healthz",

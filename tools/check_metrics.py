@@ -53,8 +53,25 @@ REQUIRED_METRICS = (
     "tpudas_serve_pyramid_append_seconds",
     "tpudas_serve_pyramid_appended_samples_total",
     "tpudas_serve_pyramid_errors_total",
+    # integrity layer (PR 5): the fsck CLI, the crash drill, and the
+    # RESILIENCE.md runbook all read these by name
+    "tpudas_integrity_fallback_total",
+    "tpudas_integrity_unstamped_total",
+    "tpudas_integrity_audit_runs_total",
+    "tpudas_integrity_audit_repairs_total",
+    "tpudas_integrity_audit_errors_total",
+    "tpudas_integrity_audit_seconds",
+    "tpudas_integrity_resource_degraded",
+    "tpudas_integrity_resource_events_total",
+    "tpudas_integrity_writes_shed_total",
+    "tpudas_serve_pyramid_rebuilds_total",
 )
-REQUIRED_SPANS = ("serve.request", "serve.query", "serve.pyramid_append")
+REQUIRED_SPANS = (
+    "serve.request",
+    "serve.query",
+    "serve.pyramid_append",
+    "integrity.audit",
+)
 
 
 def iter_source_files(repo: str = REPO):

@@ -1,0 +1,360 @@
+"""Process-level crash drill: SIGKILL the realtime driver at seeded
+random points, then prove the folder audits clean and resumes
+byte-identically.
+
+The missing end-to-end proof behind the crash-only claims: PR 3/4
+killed the driver with injected exceptions at chosen fault sites; this
+drill kills the *process* (``SIGKILL`` — no handlers, no cleanup, the
+power-cut model) at points drawn from a seeded RNG, so the kill can
+land inside any write: mid-``np.savez``, between a tile and its
+manifest, halfway through an HDF5 output flush.
+
+One drill (per engine):
+
+1. seed a source spool, run uninterrupted worker cycles to calibrate
+   the processing wall time;
+2. for each of N cycles: feed one more interrogator file — but only
+   when the PREVIOUS cycle ran to completion (epoch gating, below) —
+   spawn the driver in a fresh subprocess (pyramid + health +
+   stateful carry on), SIGKILL it ``uniform(0.02, 0.95 * calib)``
+   seconds after it becomes ready;
+3. run one final uninterrupted cycle to drain, then assert
+   ``tpudas.integrity.audit`` reports **clean** (each worker already
+   audited + repaired at startup — this run must find nothing left);
+4. replay the SAME epoch schedule uninterrupted into a fresh control
+   folder and assert:
+
+   - the merged OUTPUT CONTENT (time grid + float32 samples) is
+     byte-identical — output *file boundaries* are round-schedule
+     dependent, so files are compared by merged content, not name;
+   - the tile pyramid is byte-identical file-by-file (tiles, tails,
+     manifest).
+
+**Epoch gating.**  The carry only advances when a round completes, so
+every processing attempt spans exactly [end of last completed epoch →
+end of fed data]: holding the fed data fixed until a cycle completes
+it makes the killed run's effective consumption schedule identical to
+an uninterrupted run over the same epochs — which is precisely what
+crash-only resume promises, and the strongest claim that CAN hold
+byte-for-byte: the FFT engine's per-block frequency masking is
+chunk-schedule dependent by design (a cascade-only drill without the
+gating also passes, because the FIR cascade is bit-exact under any
+chunking).
+
+CLI (the full acceptance drill — ``BENCH_pr05.json`` records a run):
+
+    JAX_PLATFORMS=cpu python tools/crash_drill.py \
+        [--cycles 25] [--seed 0] [--engines cascade,fft] [--out PATH]
+
+``tests/test_integrity.py`` runs a small seeded smoke in tier-1 and
+the full drill under ``-m slow``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+T0 = "2023-03-22T00:00:00"
+FS = 50.0
+FILE_SEC = 20.0
+N_CH = 4
+DT_OUT = 1.0
+EDGE_SEC = 5.0
+PATCH_OUT = 20
+
+
+# ---------------------------------------------------------------------------
+# the worker (runs in the subprocess being killed)
+
+def _worker(src: str, out: str, engine: str) -> int:
+    import time as _t
+
+    from tpudas.proc.streaming import run_lowpass_realtime
+
+    # ready marker BESIDE the output folder: the parent starts its
+    # kill timer only after the interpreter/jax warm-up is done, so
+    # kills land in processing, not in `import jax`
+    os.makedirs(out, exist_ok=True)
+    with open(out + ".ready", "w") as fh:
+        fh.write(str(os.getpid()))
+    run_lowpass_realtime(
+        source=src,
+        output_folder=out,
+        start_time=T0,
+        output_sample_interval=DT_OUT,
+        edge_buffer=EDGE_SEC,
+        process_patch_size=PATCH_OUT,
+        poll_interval=0.0,
+        sleep_fn=lambda _s: _t.sleep(0.01),
+        engine=engine,
+        pyramid=True,
+        health=True,
+        max_rounds=8,
+    )
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# the parent harness
+
+def _feed(src: str, first_index: int, n_files: int) -> None:
+    import numpy as np
+
+    from tpudas.testing import make_synthetic_spool
+
+    make_synthetic_spool(
+        src, n_files=n_files, file_duration=FILE_SEC, fs=FS, n_ch=N_CH,
+        noise=0.01,
+        start=np.datetime64(T0)
+        + np.timedelta64(int(first_index * FILE_SEC * 1e9), "ns"),
+        prefix=f"raw{first_index:04d}",
+    )
+
+
+def _rm_ready(out: str) -> None:
+    try:
+        os.remove(out + ".ready")
+    except OSError:
+        pass
+
+
+def _run_cycle(src, out, engine, kill_after, log_fh=None) -> dict:
+    """One worker subprocess; ``kill_after`` seconds after READY send
+    SIGKILL (None = let it finish).  Returns {killed, wall}."""
+    _rm_ready(out)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # share one persistent XLA cache across worker processes: after
+    # the cold calibration cycle every worker warm-starts, so kills
+    # land in real processing/write windows instead of jit compiles
+    env.setdefault(
+        "TPUDAS_COMPILE_CACHE",
+        os.path.join(os.path.dirname(out), "xla_cache"),
+    )
+    proc = subprocess.Popen(
+        [
+            sys.executable, os.path.abspath(__file__),
+            "--worker", src, out, engine,
+        ],
+        env=env,
+        stdout=log_fh if log_fh is not None else subprocess.DEVNULL,
+        stderr=subprocess.STDOUT if log_fh is not None else (
+            subprocess.DEVNULL
+        ),
+    )
+    t0 = time.time()
+    ready = out + ".ready"
+    while not os.path.isfile(ready):
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"crash-drill worker exited rc={proc.returncode} "
+                "before becoming ready (see --log)"
+            )
+        if time.time() - t0 > 300:
+            proc.kill()
+            raise RuntimeError("crash-drill worker never became ready")
+        time.sleep(0.01)
+    t_ready = time.time()
+    killed = False
+    if kill_after is None:
+        proc.wait(timeout=600)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"uninterrupted crash-drill worker failed "
+                f"rc={proc.returncode}"
+            )
+    else:
+        while proc.poll() is None and time.time() - t_ready < kill_after:
+            time.sleep(0.002)
+        if proc.poll() is None:
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait()
+            killed = True
+    return {"killed": killed, "wall": round(time.time() - t_ready, 3)}
+
+
+def _content_hash(folder: str) -> str:
+    """sha256 of the merged output content: the ns time grid plus the
+    float32 samples, independent of how emission chunked the files."""
+    import numpy as np
+
+    from tpudas.io.spool import spool as make_spool
+
+    h = hashlib.sha256()
+    sp = make_spool(folder).sort("time").update()
+    for patch in sp.chunk(time=None):
+        d = patch.host_data()
+        ax = patch.axis_of("time")
+        if ax != 0:
+            d = np.moveaxis(d, ax, 0)
+        times = (
+            np.asarray(patch.coords["time"])
+            .astype("datetime64[ns]")
+            .astype(np.int64)
+        )
+        h.update(times.tobytes())
+        h.update(
+            np.ascontiguousarray(np.asarray(d, np.float32)).tobytes()
+        )
+    return h.hexdigest()
+
+
+def _pyramid_tree(folder: str) -> dict:
+    """{relpath: sha256} of the pyramid files (``.prev`` history and
+    tmp leftovers excluded — they are append-schedule dependent)."""
+    from tpudas.serve.tiles import TILE_DIRNAME
+    from tpudas.utils.atomicio import is_tmp_name
+
+    tiles = os.path.join(folder, TILE_DIRNAME)
+    out = {}
+    for dirpath, _dirnames, filenames in os.walk(tiles):
+        for name in sorted(filenames):
+            if ".prev" in name or is_tmp_name(name):
+                continue
+            path = os.path.join(dirpath, name)
+            with open(path, "rb") as fh:
+                digest = hashlib.sha256(fh.read()).hexdigest()
+            out[os.path.relpath(path, tiles)] = digest
+    return out
+
+
+def run_drill(
+    engine: str = "cascade",
+    cycles: int = 25,
+    seed: int = 0,
+    workdir: str | None = None,
+    files_init: int = 2,
+    files_per_cycle: int = 1,
+    log_path: str | None = None,
+) -> dict:
+    """One full drill for ``engine``; returns the report dict with
+    ``ok`` True when the audit is clean and both comparisons match."""
+    import numpy as np
+
+    from tpudas.integrity.audit import audit
+
+    workdir = workdir or tempfile.mkdtemp(prefix=f"crash_drill_{engine}_")
+    src = os.path.join(workdir, "src")
+    out = os.path.join(workdir, "out")
+    ctrl = os.path.join(workdir, "ctrl")
+    log_fh = open(log_path, "ab") if log_path else None
+    try:
+        # epochs: every feed event, replayed verbatim for the control
+        epochs = [(0, files_init)]
+        _feed(src, 0, files_init)
+        # cold calibration: seeds the carry AND the shared XLA cache
+        cold = _run_cycle(src, out, engine, None, log_fh)
+        # warm calibration: the est the kill distribution draws from
+        epochs.append((files_init, files_per_cycle))
+        _feed(src, files_init, files_per_cycle)
+        warm = _run_cycle(src, out, engine, None, log_fh)
+        est = max(warm["wall"], 0.2)
+        rng = np.random.default_rng(seed)
+        n_files = files_init + files_per_cycle
+        kills = 0
+        cycle_log = []
+        advance = True  # the last cycle completed its epoch
+        for _c in range(int(cycles)):
+            if advance:
+                epochs.append((n_files, files_per_cycle))
+                _feed(src, n_files, files_per_cycle)
+                n_files += files_per_cycle
+            kill_after = float(rng.uniform(0.02, est * 0.95))
+            r = _run_cycle(src, out, engine, kill_after, log_fh)
+            kills += int(r["killed"])
+            advance = not r["killed"]
+            if not r["killed"]:
+                # the worker outran the timer: track the real wall so
+                # later draws keep landing inside the work window
+                est = max(0.5 * est + 0.5 * r["wall"], 0.2)
+            cycle_log.append({"kill_after": round(kill_after, 3), **r})
+        # drain: the resumed run finishes everything the kills left
+        _run_cycle(src, out, engine, None, log_fh)
+        # the drained folder must audit clean (each worker already
+        # audited at startup; this run may not find anything new)
+        report = audit(out, repair=True)
+        # control: replay the SAME epoch schedule, uninterrupted
+        ctrl_src = os.path.join(workdir, "ctrl_src")
+        for first, count in epochs:
+            _feed(ctrl_src, first, count)
+            _run_cycle(ctrl_src, ctrl, engine, None, log_fh)
+        outputs_match = _content_hash(out) == _content_hash(ctrl)
+        pyr_out, pyr_ctrl = _pyramid_tree(out), _pyramid_tree(ctrl)
+        pyramid_match = pyr_out == pyr_ctrl
+        return {
+            "engine": engine,
+            "cycles": int(cycles),
+            "seed": int(seed),
+            "kills": kills,
+            "epochs": len(epochs),
+            "cold_wall_s": cold["wall"],
+            "warm_wall_s": warm["wall"],
+            "audit_clean": bool(report["clean"]),
+            "audit_issues": len(report["issues"]),
+            "outputs_match": bool(outputs_match),
+            "pyramid_match": bool(pyramid_match),
+            "pyramid_files": len(pyr_out),
+            "cycle_log": cycle_log,
+            "workdir": workdir,
+            "ok": bool(
+                report["clean"] and outputs_match and pyramid_match
+            ),
+        }
+    finally:
+        if log_fh is not None:
+            log_fh.close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--cycles", type=int, default=25)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--engines", default="cascade,fft",
+        help="comma-separated engine list",
+    )
+    ap.add_argument("--out", default=None, help="write JSON report here")
+    ap.add_argument("--log", default=None, help="worker stdout log file")
+    args = ap.parse_args(argv)
+    results = {}
+    ok = True
+    for engine in [e for e in args.engines.split(",") if e]:
+        print(f"crash_drill: engine={engine} cycles={args.cycles} "
+              f"seed={args.seed}")
+        rep = run_drill(
+            engine=engine, cycles=args.cycles, seed=args.seed,
+            log_path=args.log,
+        )
+        results[engine] = rep
+        ok = ok and rep["ok"]
+        print(
+            f"crash_drill: {engine}: kills={rep['kills']} "
+            f"audit_clean={rep['audit_clean']} "
+            f"outputs_match={rep['outputs_match']} "
+            f"pyramid_match={rep['pyramid_match']}"
+        )
+    payload = {"cycles": args.cycles, "seed": args.seed, "ok": ok,
+               "engines": results}
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(payload, fh, indent=1)
+    print(f"crash_drill: {'OK' if ok else 'FAILED'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 5 and sys.argv[1] == "--worker":
+        sys.exit(_worker(sys.argv[2], sys.argv[3], sys.argv[4]))
+    sys.exit(main())

@@ -1,0 +1,67 @@
+"""fsck for a tpudas output folder: audit (and repair) durable state.
+
+Operator CLI over :func:`tpudas.integrity.audit`, the same scan the
+realtime drivers run automatically before their first round.  Checks
+every durable artifact beside the stream — carry, quarantine ledger,
+health snapshot, directory-index cache, tile pyramid — verifies
+checksums, classifies defects (unstamped / torn / corrupt / stale-tmp
+/ orphan tile), and repairs via the degradation ladder (restamp,
+promote ``.prev``, remove, rebuild the pyramid from the outputs).
+
+    JAX_PLATFORMS=cpu python tools/fsck.py OUTPUT_FOLDER [options]
+
+Options:
+    --no-repair     report only; change nothing on disk
+    --no-rebuild    repair everything except pyramid rebuilds
+    --out PATH      also write the JSON report to PATH
+
+Run only while the driver is stopped: the stale-tmp sweep cannot tell
+a crashed writer's leftovers from a live writer's in-flight file.
+
+Exit code 0 when the folder is clean after the run (every issue
+repaired, or no issues), 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("folder", help="output folder to audit")
+    ap.add_argument(
+        "--no-repair", action="store_true",
+        help="report only; change nothing on disk",
+    )
+    ap.add_argument(
+        "--no-rebuild", action="store_true",
+        help="repair everything except pyramid rebuilds",
+    )
+    ap.add_argument("--out", default=None, help="write JSON report here")
+    args = ap.parse_args(argv)
+
+    from tpudas.integrity.audit import audit
+
+    report = audit(
+        args.folder,
+        repair=not args.no_repair,
+        rebuild=not args.no_rebuild,
+    )
+    text = json.dumps(report, indent=1)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(text + "\n")
+    return 0 if report["clean"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,328 @@
+"""Integrity-layer bench (CPU): the ISSUE 5 acceptance artifact.
+
+Four sections, written to one JSON (default ``BENCH_pr05.json``):
+
+- ``overhead`` — the steady-round cost of checksummed writes +
+  verified reads.  Per steady round the integrity layer adds: crc32
+  stamping of every artifact written that round (carry ``.npz`` +
+  ``.crc``, carry ``.json`` sidecar, ``health.json``, the index
+  cache, and with the pyramid on the manifest + ``tails.npy``), plus
+  the ``fs.write_enospc`` / ``integrity.verify`` fault-point checks
+  (no plan: one global ``is None`` each).  Verified READS are
+  stat-gated off the steady path (the manifest/tails reload only on
+  change; the carry verifies once per resume), so the steady cost is
+  the stamping.  A whole-drive A/B cannot resolve sub-1% under
+  shared-CPU scheduler noise (BENCH_pr02/pr03 taught us this), so the
+  stamp bundle is replayed deterministically over the run's REAL
+  artifact bytes and reported against the measured steady-round
+  floor.  Acceptance: < 1%.
+- ``enospc`` — injected disk-full during a live run: non-essential
+  writers shed (counted), ``health.json`` goes ``degraded`` with
+  ``resource_degraded`` true, core outputs still produced
+  byte-identically, and the driver self-recovers the round after the
+  fault window closes.
+- ``fsck`` — damage a folder five ways (bit flip, truncation, stale
+  tmp, torn output, orphan tile), audit-repair it, and verify the
+  SECOND audit is clean.
+- ``crash_drill`` — a short seeded SIGKILL drill
+  (tools/crash_drill.py; the full 25-cycle x 2-engine acceptance run
+  is the CLI default of that tool).
+
+    JAX_PLATFORMS=cpu python tools/integrity_bench.py [--out PATH]
+
+Exit code 0 when every acceptance condition holds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+T0 = "2023-03-22T00:00:00"
+FS = 100.0
+FILE_SEC = 30.0
+N_CH = 16
+DT_OUT = 1.0
+EDGE_SEC = 40.0
+PATCH_OUT = 100
+
+
+def _feed(src, first_index, n_files):
+    from tpudas.testing import make_synthetic_spool
+
+    make_synthetic_spool(
+        src, n_files=n_files, file_duration=FILE_SEC, fs=FS, n_ch=N_CH,
+        noise=0.01,
+        start=np.datetime64(T0)
+        + np.timedelta64(int(first_index * FILE_SEC * 1e9), "ns"),
+        prefix=f"raw{first_index:04d}",
+    )
+
+
+def _drive(src, out, rounds, files_per_round, n_init, pyramid=True,
+           on_round_extra=None, plan=None):
+    """A stateful realtime run (health+pyramid on) under a fresh
+    registry, feeding ``files_per_round`` new files per round.
+    Returns (per-round body seconds, registry)."""
+    from tpudas.obs.registry import MetricsRegistry, use_registry
+    from tpudas.proc.streaming import run_lowpass_realtime
+    from tpudas.resilience.faults import RetryPolicy, install_fault_plan
+
+    reg = MetricsRegistry()
+    state = {"fed": 0, "bodies": [], "last_sum": 0.0}
+
+    def sleep(_):
+        if state["fed"] < rounds - 1:
+            state["fed"] += 1
+            _feed(src, n_init + (state["fed"] - 1) * files_per_round,
+                  files_per_round)
+
+    def on_round(rnd, lfp):
+        h = reg.get("tpudas_stream_round_body_seconds")
+        snap = h.snapshot() if h is not None else {"sum": 0.0}
+        state["bodies"].append(snap["sum"] - state["last_sum"])
+        state["last_sum"] = snap["sum"]
+        if on_round_extra is not None:
+            on_round_extra(rnd, lfp)
+
+    policy = RetryPolicy(base_delay=0.0, max_delay=0.0, jitter=0.0)
+    with use_registry(reg), install_fault_plan(plan):
+        run_lowpass_realtime(
+            source=src, output_folder=out, start_time=T0,
+            output_sample_interval=DT_OUT, edge_buffer=EDGE_SEC,
+            process_patch_size=PATCH_OUT, poll_interval=0.0,
+            sleep_fn=sleep, on_round=on_round, fault_policy=policy,
+            health=True, pyramid=pyramid,
+        )
+    return state["bodies"], reg
+
+
+def _hashes(folder):
+    return {
+        f: hashlib.sha256(
+            open(os.path.join(folder, f), "rb").read()
+        ).hexdigest()
+        for f in sorted(os.listdir(folder))
+        if f.endswith(".h5")
+    }
+
+
+# ---------------------------------------------------------------------------
+
+def bench_overhead(workdir) -> dict:
+    from tpudas.integrity.checksum import crc32_hex, stamp_json
+
+    src = os.path.join(workdir, "ov_src")
+    out = os.path.join(workdir, "ov_out")
+    n_init, per_round, rounds = 2, 1, 6
+    _feed(src, 0, n_init)
+    bodies, _reg = _drive(src, out, rounds, per_round, n_init)
+    # steady-round floor: skip the cold compile round
+    steady = sorted(bodies[1:])[0] if len(bodies) > 1 else bodies[0]
+    # the per-round stamp bundle, replayed over the REAL artifact bytes
+    arts = {}
+    for name in (".stream_carry.npz", "health.json",
+                 ".stream_carry.json", ".tpudas_index.json",
+                 os.path.join(".tiles", "manifest.json"),
+                 os.path.join(".tiles", "tails.npy")):
+        path = os.path.join(out, name)
+        if os.path.isfile(path):
+            with open(path, "rb") as fh:
+                arts[name] = fh.read()
+    json_arts = {
+        n: json.loads(b) for n, b in arts.items()
+        if n.endswith(".json")
+    }
+    reps = 200
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        for name, payload in arts.items():
+            if name in json_arts:
+                stamp_json(json_arts[name])  # canonical dump + crc32
+            else:
+                crc32_hex(payload)
+    bundle_s = (time.perf_counter() - t0) / reps
+    pct = 100.0 * bundle_s / steady if steady > 0 else 0.0
+    return {
+        "steady_round_floor_s": round(steady, 5),
+        "round_bodies_s": [round(b, 4) for b in bodies],
+        "artifact_bytes": {n: len(b) for n, b in arts.items()},
+        "stamp_bundle_s": round(bundle_s, 7),
+        "overhead_pct": round(pct, 4),
+        "pass": pct < 1.0,
+    }
+
+
+def bench_enospc(workdir) -> dict:
+    from tpudas.obs.health import read_health
+    from tpudas.resilience.faults import FaultPlan, FaultSpec
+    from tpudas.resilience.faults import install_fault_plan
+    from tpudas.serve.tiles import sync_pyramid
+    from tpudas.testing import enospc_error
+    from tpudas.integrity import resource as _resource
+
+    n_init, per_round, rounds = 2, 1, 5
+    # control (no faults)
+    csrc = os.path.join(workdir, "en_csrc")
+    cout = os.path.join(workdir, "en_cout")
+    _feed(csrc, 0, n_init)
+    _drive(csrc, cout, rounds, per_round, n_init)
+    control = _hashes(cout)
+    # faulted: every .tiles / metrics.prom / probe write hits ENOSPC
+    # until round 3 lifts the plan (space "returns")
+    src = os.path.join(workdir, "en_src")
+    out = os.path.join(workdir, "en_out")
+    _feed(src, 0, n_init)
+    plan = FaultPlan(
+        FaultSpec("fs.write_enospc", at=1, times=10**6,
+                  exc=enospc_error(), match=".tiles"),
+        FaultSpec("fs.write_enospc", at=1, times=10**6,
+                  exc=enospc_error(), match="metrics.prom"),
+        FaultSpec("fs.write_enospc", at=1, times=10**6,
+                  exc=enospc_error(), match=".space_probe"),
+    )
+    seen = []
+
+    def on_round_extra(rnd, lfp):
+        h = read_health(out)
+        seen.append(
+            None if h is None
+            else (h["degraded"], h["resource_degraded"])
+        )
+        if rnd == 3:
+            install_fault_plan(None)  # space returns
+
+    bodies, reg = _drive(
+        src, out, rounds, per_round, n_init, plan=plan,
+        on_round_extra=on_round_extra,
+    )
+    shed_pyr = reg.value("tpudas_integrity_writes_shed_total",
+                         writer="pyramid")
+    shed_prom = reg.value("tpudas_integrity_writes_shed_total",
+                          writer="prom")
+    final = read_health(out)
+    pyramid_rows = sync_pyramid(out)  # 0 = already caught up
+    got = _hashes(out)
+    ok = (
+        got == control
+        and shed_pyr >= 1
+        and shed_prom >= 1
+        and any(s == (True, True) for s in seen if s)
+        and final is not None
+        and final["resource_degraded"] is False
+        and not _resource.is_degraded()
+    )
+    return {
+        "outputs_match_control": got == control,
+        "rounds_health": [list(s) if s else None for s in seen],
+        "shed_pyramid_rounds": shed_pyr,
+        "shed_prom_rounds": shed_prom,
+        "resource_events": reg.value(
+            "tpudas_integrity_resource_events_total"
+        ),
+        "recovered": final is not None
+        and final["resource_degraded"] is False,
+        "pyramid_backfill_rows": int(pyramid_rows),
+        "pass": bool(ok),
+    }
+
+
+def bench_fsck(workdir) -> dict:
+    from tpudas.integrity.audit import audit
+
+    src = os.path.join(workdir, "fs_src")
+    out = os.path.join(workdir, "fs_out")
+    _feed(src, 0, 2)
+    _drive(src, out, 3, 1, 2)
+    # five ways to hurt a folder
+    carry = os.path.join(out, ".stream_carry.npz")
+    with open(carry, "r+b") as fh:  # bit flip
+        fh.seek(100)
+        b = fh.read(1)
+        fh.seek(100)
+        fh.write(bytes([b[0] ^ 0xFF]))
+    manifest = os.path.join(out, ".tiles", "manifest.json")
+    with open(manifest, "r+b") as fh:  # truncation
+        fh.truncate(os.path.getsize(manifest) // 2)
+    open(os.path.join(out, "health.json.tmp.12345"), "w").write("junk")
+    open(os.path.join(out, "LFDAS_2099-01-01T000000.0_"
+                           "2099-01-01T000100.0.h5"), "w").write("torn")
+    os.makedirs(os.path.join(out, ".tiles", "L0"), exist_ok=True)
+    orphan = os.path.join(out, ".tiles", "L0", "00009999.npy")
+    open(orphan, "wb").write(b"garbage")
+    t0 = time.perf_counter()
+    rep1 = audit(out, repair=True)
+    elapsed = time.perf_counter() - t0
+    rep2 = audit(out, repair=True)
+    return {
+        "first_audit": {
+            "clean": rep1["clean"],
+            "repaired": rep1["repaired"],
+            "counts": rep1["counts"],
+            "elapsed_s": round(elapsed, 4),
+        },
+        "second_audit_issues": len(rep2["issues"]),
+        "pass": bool(rep1["clean"] and not rep2["issues"]),
+    }
+
+
+def bench_crash_drill(cycles, seed) -> dict:
+    from tools.crash_drill import run_drill
+
+    rep = run_drill(engine="cascade", cycles=cycles, seed=seed)
+    rep.pop("cycle_log", None)
+    rep.pop("workdir", None)
+    rep["pass"] = rep.pop("ok")
+    return rep
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default=os.path.join(REPO, "BENCH_pr05.json"))
+    ap.add_argument("--drill-cycles", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    t0 = time.time()
+    results = {}
+    with tempfile.TemporaryDirectory(prefix="integrity_bench_") as wd:
+        print("integrity_bench: overhead ...")
+        results["overhead"] = bench_overhead(wd)
+        print(json.dumps(results["overhead"], indent=1))
+        print("integrity_bench: enospc ...")
+        results["enospc"] = bench_enospc(wd)
+        print(json.dumps(results["enospc"], indent=1))
+        print("integrity_bench: fsck ...")
+        results["fsck"] = bench_fsck(wd)
+        print(json.dumps(results["fsck"], indent=1))
+    print("integrity_bench: crash_drill ...")
+    results["crash_drill"] = bench_crash_drill(
+        args.drill_cycles, args.seed
+    )
+    print(json.dumps(results["crash_drill"], indent=1))
+    ok = all(results[k]["pass"] for k in results)
+    payload = {
+        "bench": "integrity (ISSUE 5)",
+        "elapsed_s": round(time.time() - t0, 1),
+        "pass": ok,
+        **results,
+    }
+    with open(args.out, "w") as fh:
+        json.dump(payload, fh, indent=1)
+        fh.write("\n")
+    print(f"integrity_bench: {'OK' if ok else 'FAILED'} -> {args.out}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,7 @@ from tpudas.core.timeutils import to_datetime64, to_timedelta64
 from tpudas.core.mapping import FrozenDict
 from tpudas.io.spool import spool, BaseSpool, MemorySpool, DirectorySpool
 from tpudas.core import units
+from tpudas import integrity
 from tpudas import obs
 from tpudas import resilience
 from tpudas import serve
@@ -27,6 +28,7 @@ __version__ = "0.8.0"
 __all__ = [
     "Patch",
     "spool",
+    "integrity",
     "obs",
     "resilience",
     "serve",

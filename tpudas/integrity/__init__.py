@@ -1,0 +1,70 @@
+"""tpudas.integrity: checksummed persistent state, startup
+audit/repair, and disk-full graceful degradation.
+
+The paper's product is the on-disk state next to the interrogator —
+stream carry, quarantine ledger, tile pyramid, health snapshots — and
+nobody is around to notice bit rot, torn writes after power loss, or a
+filling disk.  PR 3 made in-process faults survivable and PR 4 made
+artifacts crash-only by ordering; this package makes corruption
+**detectable** and **repairable**:
+
+- :mod:`tpudas.integrity.checksum` — crc32 stamping (embedded for
+  JSON, ``.crc`` sidecar for binary) and the verified-read helpers
+  every durable artifact now goes through.  A rejected primary falls
+  down a degradation ladder — ``.prev`` double buffer →
+  rebuild-from-outputs → rewind — each step counted
+  (``tpudas_integrity_fallback_total``) and surfaced in
+  ``health.json``;
+- :mod:`tpudas.integrity.audit` — the startup "fsck": scans every
+  artifact, classifies (ok / unstamped / torn / corrupt / stale-tmp /
+  orphan tile), repairs what it can, and runs automatically before the
+  realtime drivers' first round (``tools/fsck.py`` is the operator
+  CLI);
+- :mod:`tpudas.integrity.resource` — ``ENOSPC``/``EDQUOT`` graceful
+  degradation: shed non-essential writers (pyramid, metrics.prom)
+  while the core stream + carry stay alive, recover automatically when
+  a probe write succeeds.
+
+See RESILIENCE.md ("Integrity & recovery") for formats, the ladder,
+and the fsck / crash-drill runbook.
+"""
+
+from tpudas.integrity.audit import audit
+from tpudas.integrity.checksum import (
+    CRC_KEY,
+    SIDECAR_SUFFIX,
+    crc32_hex,
+    fallback_count,
+    stamp_json,
+    verify_file_checksum,
+    verify_json_obj,
+    write_json_checksummed,
+    write_sidecar_for,
+)
+from tpudas.integrity.resource import (
+    RESOURCE_ERRNOS,
+    is_degraded,
+    is_resource_error,
+    note_pressure,
+    probe_recovery,
+    should_shed,
+)
+
+__all__ = [
+    "CRC_KEY",
+    "RESOURCE_ERRNOS",
+    "SIDECAR_SUFFIX",
+    "audit",
+    "crc32_hex",
+    "fallback_count",
+    "is_degraded",
+    "is_resource_error",
+    "note_pressure",
+    "probe_recovery",
+    "should_shed",
+    "stamp_json",
+    "verify_file_checksum",
+    "verify_json_obj",
+    "write_json_checksummed",
+    "write_sidecar_for",
+]

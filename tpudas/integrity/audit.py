@@ -1,0 +1,634 @@
+"""Startup audit / repair ("fsck") for one output folder's durable state.
+
+The realtime drivers call :func:`audit` once at startup — before the
+first round, before the quarantine ledger loads — and
+``tools/fsck.py`` exposes it as an operator CLI.  It scans every
+durable artifact the pipeline writes beside the stream:
+
+==================  =====================================================
+artifact            files
+==================  =====================================================
+``carry``           ``.stream_carry.npz`` (+ ``.crc``/``.prev``) and the
+                    ``.stream_carry.json`` sidecar
+``quarantine``      ``.quarantine.json`` (+ ``.prev``)
+``health``          ``health.json`` (+ ``.prev``)
+``index``           ``.tpudas_index.json`` (+ ``.prev``)
+``pyramid``         ``.tiles/manifest.json`` (+ ``.prev``),
+                    ``.tiles/tails.npy``, ``.tiles/L*/NNNNNNNN.npy``
+``tmp``             any ``*.tmp`` / ``*.tmp.<pid>`` leftover anywhere in
+                    the tree (a crashed writer's half file)
+==================  =====================================================
+
+and classifies each as ``ok`` (not reported), ``unstamped`` (legacy,
+no checksum yet), ``torn`` (crc32 mismatch — a torn/partial write or
+bit rot), ``corrupt`` (does not even parse / internally inconsistent),
+``stale_tmp``, or ``orphan`` (a tile beyond the manifest head that
+also fails verification).  With ``repair=True`` (the default) it then
+fixes what it can, in artifact-appropriate ways:
+
+- stale tmp files are **removed** (regenerable by construction);
+- unstamped-but-parseable artifacts are **restamped** in place;
+- a bad primary with a good ``.prev`` is **promoted** (the ladder's
+  runtime fallback, made durable);
+- a bad primary with no good ``.prev`` is **removed** — every reader
+  treats absence safely (carry → rewind, ledger → empty, health →
+  regenerated next round, index → rescan);
+- a bad in-use pyramid artifact triggers a **rebuild** of ``.tiles/``
+  from the output files (byte-identical, the store is derived data).
+
+Run the CLI only while the driver is stopped (the tmp sweep cannot
+tell a crashed writer's leftovers from a live writer's in-flight
+file); the driver's own startup call cannot race anything because its
+writers have not started.
+
+A second audit immediately after a repairing one reports ``clean``
+with zero issues — the crash-drill (tools/crash_drill.py) asserts
+exactly that after every SIGKILL.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import time
+
+from tpudas.integrity.checksum import (
+    read_json_verified,
+    sidecar_path,
+    verify_file_checksum,
+    write_json_checksummed,
+    write_sidecar_for,
+)
+from tpudas.obs.registry import get_registry
+from tpudas.obs.trace import span
+from tpudas.utils.atomicio import is_tmp_name
+from tpudas.utils.logging import log_event
+
+__all__ = ["audit"]
+
+_TILE_NAME_RE = re.compile(r"^(\d{8})\.npy$")
+
+
+def _issue(issues, artifact, path, status, action, detail=""):
+    issues.append(
+        {
+            "artifact": artifact,
+            "path": str(path),
+            "status": status,
+            "action": action,
+            "detail": str(detail)[:200],
+        }
+    )
+
+
+def _repair_action(repair: bool, action: str) -> str:
+    return action if repair else "found"
+
+
+def _promote_prev(path: str) -> None:
+    """Replace a bad primary with its good ``.prev`` (sidecar
+    included)."""
+    for p in (path, sidecar_path(path)):
+        if os.path.isfile(p):
+            os.remove(p)
+    os.replace(path + ".prev", path)
+    prev_side = sidecar_path(path + ".prev")
+    if os.path.isfile(prev_side):
+        os.replace(prev_side, sidecar_path(path))
+
+
+def _remove_all(*paths) -> None:
+    for p in paths:
+        if os.path.isfile(p):
+            os.remove(p)
+
+
+# ---------------------------------------------------------------------------
+# per-artifact checks
+
+def _sweep_tmp(folder: str, issues: list, repair: bool) -> None:
+    for dirpath, _dirnames, filenames in os.walk(folder):
+        for name in sorted(filenames):
+            if not is_tmp_name(name):
+                continue
+            path = os.path.join(dirpath, name)
+            if repair:
+                try:
+                    os.remove(path)
+                except OSError as exc:
+                    _issue(issues, "tmp", path, "stale_tmp", "failed", exc)
+                    continue
+            _issue(
+                issues, "tmp", path, "stale_tmp",
+                _repair_action(repair, "removed"),
+            )
+
+
+def _json_status(path: str, artifact: str, validate=None) -> tuple:
+    """(status, payload_or_None, detail): status in ok | unstamped |
+    torn | corrupt | absent."""
+    if not os.path.isfile(path):
+        return "absent", None, ""
+    try:
+        payload, status = read_json_verified(path, artifact)
+    except Exception as exc:
+        return "corrupt", None, f"{type(exc).__name__}: {str(exc)[:120]}"
+    if status == "mismatch":
+        return "torn", payload, "crc32 mismatch"
+    try:
+        if validate is not None:
+            validate(payload)
+    except Exception as exc:
+        return "corrupt", payload, f"{type(exc).__name__}: {str(exc)[:120]}"
+    return ("unstamped" if status == "unstamped" else "ok"), payload, ""
+
+
+def _check_json_artifact(
+    path: str, artifact: str, issues: list, repair: bool, validate=None
+) -> None:
+    """The shared JSON ladder repair: restamp unstamped, promote a good
+    ``.prev`` over a torn/corrupt primary, remove what nothing can
+    save (absence is safe for every JSON artifact)."""
+    prev = path + ".prev"
+    status, payload, detail = _json_status(path, artifact, validate)
+    if status == "ok":
+        pass
+    elif status == "absent":
+        # a lone .prev is the crash window between the save's rotate
+        # and write: promote a good one, remove a bad one — either
+        # way the NEXT audit (and every runtime read) finds nothing
+        # to fall back over
+        if os.path.isfile(prev):
+            p_status, p_payload, p_detail = _json_status(
+                prev, artifact, validate
+            )
+            if p_status in ("ok", "unstamped"):
+                if repair:
+                    os.replace(prev, path)
+                    if p_status == "unstamped":
+                        write_json_checksummed(path, p_payload)
+                _issue(
+                    issues, artifact, prev, "torn",
+                    _repair_action(repair, "promoted_prev"),
+                    "orphaned .prev (primary missing)",
+                )
+            else:
+                if repair:
+                    _remove_all(prev)
+                _issue(
+                    issues, artifact, prev, p_status,
+                    _repair_action(repair, "removed"), p_detail,
+                )
+        return
+    elif status == "unstamped":
+        if repair:
+            write_json_checksummed(path, payload)
+        _issue(
+            issues, artifact, path, "unstamped",
+            _repair_action(repair, "restamped"),
+        )
+    else:  # torn | corrupt
+        p_status, p_payload, _ = _json_status(prev, artifact, validate)
+        if p_status in ("ok", "unstamped"):
+            if repair:
+                os.remove(path)
+                os.replace(prev, path)
+                if p_status == "unstamped":
+                    write_json_checksummed(path, p_payload)
+            _issue(
+                issues, artifact, path, status,
+                _repair_action(repair, "promoted_prev"), detail,
+            )
+        else:
+            # both rungs bad: BOTH must go, or the runtime ladder
+            # keeps tripping (counted, degraded) over the corpse of
+            # the .prev after a "clean" fsck
+            if repair:
+                _remove_all(path, prev)
+            _issue(
+                issues, artifact, path, status,
+                _repair_action(repair, "removed"), detail,
+            )
+        return
+    # a bad .prev behind a healthy primary is dead weight: sweep it
+    if os.path.isfile(prev):
+        p_status, _p, p_detail = _json_status(prev, artifact, validate)
+        if p_status in ("torn", "corrupt"):
+            if repair:
+                _remove_all(prev)
+            _issue(
+                issues, artifact, prev, p_status,
+                _repair_action(repair, "removed"), p_detail,
+            )
+
+
+def _carry_status(path: str) -> tuple:
+    """(status, carry_or_None, detail) for one carry ``.npz`` rung."""
+    from tpudas.proc.stream import _parse_carry
+
+    if not os.path.isfile(path):
+        return "absent", None, ""
+    try:
+        crc = verify_file_checksum(path, artifact="carry")
+    except FileNotFoundError:
+        return "absent", None, ""
+    try:
+        carry = _parse_carry(path)
+    except Exception as exc:
+        status = "torn" if crc == "mismatch" else "corrupt"
+        return status, None, f"{type(exc).__name__}: {str(exc)[:120]}"
+    if crc == "mismatch":
+        return "torn", None, "crc32 mismatch"
+    return ("unstamped" if crc == "unstamped" else "ok"), carry, ""
+
+
+def _check_carry(folder: str, issues: list, repair: bool) -> None:
+    from tpudas.proc.stream import CARRY_FILENAME, CARRY_SIDECAR
+
+    path = os.path.join(folder, CARRY_FILENAME)
+    side = os.path.join(folder, CARRY_SIDECAR)
+    status, carry, detail = _carry_status(path)
+    if status == "unstamped":
+        if repair:
+            write_sidecar_for(path)
+        _issue(
+            issues, "carry", path, "unstamped",
+            _repair_action(repair, "restamped"),
+        )
+        status = "ok"
+    if status in ("torn", "corrupt"):
+        p_status, p_carry, _ = _carry_status(path + ".prev")
+        if p_status in ("ok", "unstamped"):
+            if repair:
+                _promote_prev(path)
+                if p_status == "unstamped":
+                    write_sidecar_for(path)
+                carry = p_carry
+            _issue(
+                issues, "carry", path, status,
+                _repair_action(repair, "promoted_prev"), detail,
+            )
+        else:
+            if repair:
+                _remove_all(
+                    path, sidecar_path(path), path + ".prev",
+                    sidecar_path(path + ".prev"), side,
+                )
+            _issue(
+                issues, "carry", path, status,
+                _repair_action(repair, "removed"), detail,
+            )
+            return
+    elif status == "absent":
+        # a lone .prev is the crash window between the save's rotate
+        # and write: promote a good one (the state load_carry would
+        # resume from anyway), remove a bad one
+        if os.path.isfile(path + ".prev"):
+            p_status, p_carry, p_detail = _carry_status(path + ".prev")
+            if p_status in ("ok", "unstamped"):
+                if repair:
+                    _promote_prev(path)
+                    if p_status == "unstamped":
+                        write_sidecar_for(path)
+                    carry = p_carry
+                _issue(
+                    issues, "carry", path + ".prev", "torn",
+                    _repair_action(repair, "promoted_prev"),
+                    "orphaned .prev (primary missing)",
+                )
+                if carry is not None and repair:
+                    write_json_checksummed(side, carry._meta())
+                return
+            if repair:
+                _remove_all(
+                    path + ".prev", sidecar_path(path + ".prev"), side
+                )
+            _issue(
+                issues, "carry", path + ".prev", p_status,
+                _repair_action(repair, "removed"), p_detail,
+            )
+            return
+        # a sidecar with no carry is leftover state
+        if os.path.isfile(side):
+            if repair:
+                _remove_all(side)
+            _issue(
+                issues, "carry", side, "corrupt",
+                _repair_action(repair, "removed"), "sidecar without carry",
+            )
+        return
+    # the human-readable sidecar: cosmetic, regenerable from the meta
+    if carry is not None:
+        s_status, _p, s_detail = _json_status(side, "carry")
+        if s_status in ("torn", "corrupt", "absent", "unstamped"):
+            if repair:
+                write_json_checksummed(side, carry._meta())
+            if s_status != "absent":
+                _issue(
+                    issues, "carry", side, s_status,
+                    _repair_action(repair, "rewritten"), s_detail,
+                )
+
+
+def _parse_lfdas_t0(name: str):
+    """ns int of the start time encoded in an ``LFDAS_<t0>_<t1>.h5``
+    output name (tpudas.proc.naming), or None."""
+    import numpy as np
+
+    try:
+        stem = name.split("_")[1]
+        date, tod = stem.split("T")
+        iso = f"{date}T{tod[0:2]}:{tod[2:4]}:{tod[4:]}"
+        return int(
+            np.datetime64(iso).astype("datetime64[ns]").astype(np.int64)
+        )
+    except Exception:
+        return None
+
+
+def _check_outputs(folder: str, issues: list, repair: bool) -> None:
+    """Sweep torn OUTPUT files a SIGKILL left mid-HDF5-write.  Scoped
+    to files strictly newer than the carry's last emitted sample: those
+    are exactly the ones the stateful resume regenerates byte-identically
+    (the same rule :func:`tpudas.proc.stream.reconcile_outputs` applies
+    — but reconcile only sees files that SCAN, and a torn file does
+    not, so it would linger as unreadable garbage forever).  Without a
+    carry nothing is provably regenerable, so nothing is touched."""
+    from tpudas.io.registry import scan_file
+    from tpudas.proc.stream import CARRY_FILENAME
+
+    status, carry, _ = _carry_status(os.path.join(folder, CARRY_FILENAME))
+    if status != "ok" or carry is None:
+        return
+    cutoff = carry.last_emit_ns  # None = nothing emitted: all stale
+    for name in sorted(os.listdir(folder)):
+        if not (name.startswith("LFDAS_") and name.endswith(".h5")):
+            continue
+        t0 = _parse_lfdas_t0(name)
+        if t0 is None or (cutoff is not None and t0 <= cutoff):
+            continue
+        path = os.path.join(folder, name)
+        try:
+            scan_file(path, format="dasdae")
+            continue  # readable: reconcile_outputs owns it
+        except Exception as exc:
+            detail = f"{type(exc).__name__}: {str(exc)[:120]}"
+        if repair:
+            _remove_all(path)
+        _issue(
+            issues, "output", path, "torn",
+            _repair_action(repair, "removed"), detail,
+        )
+
+
+def _raw_manifest_geometry(manifest: str) -> tuple:
+    """(factor, tile_len) from whichever manifest rung still parses —
+    a checksum-IGNORED read, used only to preserve the pyramid
+    geometry across a rebuild.  (None, None) when nothing parses."""
+    import json
+
+    for path in (manifest, manifest + ".prev"):
+        try:
+            with open(path) as fh:
+                raw = json.load(fh)
+            return int(raw["factor"]), int(raw["tile_len"])
+        except (OSError, ValueError, KeyError, TypeError):
+            continue
+    return None, None
+
+
+def _tile_in_use(store, level: int, tile_idx: int) -> bool:
+    """Whether the read path can reference this tile: within the
+    manifest head, or the head tile itself (a crashed-future complete
+    file there legitimately serves the partial rows)."""
+    if store is None or level >= len(store.levels):
+        return False
+    return tile_idx <= store.n(level) // store.tile_len
+
+
+def _check_pyramid(
+    folder: str, issues: list, repair: bool, rebuild: bool
+) -> None:
+    from tpudas.serve.tiles import (
+        MANIFEST_FILENAME,
+        TILE_DIRNAME,
+        TileStore,
+        rebuild_pyramid,
+    )
+
+    tiles_dir = os.path.join(folder, TILE_DIRNAME)
+    if not os.path.isdir(tiles_dir):
+        return
+    manifest = os.path.join(tiles_dir, MANIFEST_FILENAME)
+    # capture rebuild inputs BEFORE the JSON repair can delete the
+    # rungs: whether any manifest existed at all (a store that fails
+    # to open afterwards then still rebuilds instead of stranding its
+    # tiles), and the geometry from whichever rung still parses
+    # (checksum-ignored — factor/tile_len must survive the rebuild or
+    # the byte-identical claim breaks)
+    had_manifest = os.path.isfile(manifest) or os.path.isfile(
+        manifest + ".prev"
+    )
+    geom_factor, geom_tile_len = _raw_manifest_geometry(manifest)
+    _check_json_artifact(manifest, "manifest", issues, repair)
+    store = TileStore.open(folder)
+    need_rebuild = False
+    if store is None:
+        if had_manifest:
+            need_rebuild = True
+            _issue(
+                issues, "manifest", manifest, "corrupt",
+                "pending_rebuild", "no loadable manifest rung",
+            )
+    else:
+        # tails: restamp a legacy checksum-less file, then one
+        # verified parse (the partial rows of every level)
+        tails_path = store.tails_path
+        if os.path.isfile(tails_path):
+            try:
+                crc = verify_file_checksum(tails_path, artifact="tails")
+            except FileNotFoundError:
+                crc = None
+            if crc == "unstamped":
+                if repair:
+                    write_sidecar_for(tails_path)
+                _issue(
+                    issues, "tails", tails_path, "unstamped",
+                    _repair_action(repair, "restamped"),
+                )
+        try:
+            store._load_tails()
+        except Exception as exc:
+            need_rebuild = True
+            log_event(
+                "integrity_tails_unreadable",
+                path=store.tails_path,
+                error=f"{type(exc).__name__}: {str(exc)[:120]}",
+            )
+            _issue(
+                issues, "tails", store.tails_path, "torn",
+                "pending_rebuild",
+                f"{type(exc).__name__}: {str(exc)[:120]}",
+            )
+    # every tile file: verify; restamp legacy, classify bad ones
+    for level_name in sorted(os.listdir(tiles_dir)):
+        if not level_name.startswith("L"):
+            continue
+        level_dir = os.path.join(tiles_dir, level_name)
+        if not os.path.isdir(level_dir):
+            continue
+        try:
+            level = int(level_name[1:])
+        except ValueError:
+            continue
+        for name in sorted(os.listdir(level_dir)):
+            m = _TILE_NAME_RE.match(name)
+            if m is None:
+                continue
+            tile_idx = int(m.group(1))
+            path = os.path.join(level_dir, name)
+            try:
+                crc = verify_file_checksum(path, artifact="tile")
+            except FileNotFoundError:
+                continue
+            ok_parse = True
+            if crc != "mismatch":
+                try:
+                    import numpy as np
+
+                    np.load(path)
+                except Exception:
+                    ok_parse = False
+            if crc == "ok" and ok_parse:
+                continue
+            if crc == "unstamped" and ok_parse:
+                if repair:
+                    write_sidecar_for(path)
+                _issue(
+                    issues, "tile", path, "unstamped",
+                    _repair_action(repair, "restamped"),
+                )
+                continue
+            status = "torn" if crc == "mismatch" else "corrupt"
+            if _tile_in_use(store, level, tile_idx):
+                need_rebuild = True
+                _issue(issues, "tile", path, status, "pending_rebuild")
+            else:
+                if repair:
+                    _remove_all(path, sidecar_path(path))
+                _issue(
+                    issues, "tile", path, "orphan",
+                    _repair_action(repair, "removed"),
+                )
+    if need_rebuild:
+        if repair and rebuild:
+            try:
+                rows = rebuild_pyramid(
+                    folder, factor=geom_factor, tile_len=geom_tile_len
+                )
+            except Exception as exc:
+                log_event(
+                    "integrity_pyramid_rebuild_failed",
+                    folder=folder,
+                    error=f"{type(exc).__name__}: {str(exc)[:200]}",
+                )
+                _issue(
+                    issues, "pyramid", tiles_dir, "corrupt", "failed",
+                    f"rebuild raised {type(exc).__name__}: "
+                    f"{str(exc)[:120]}",
+                )
+                return
+            for it in issues:
+                if it["action"] == "pending_rebuild":
+                    it["action"] = "rebuilt_pyramid"
+            _issue(
+                issues, "pyramid", tiles_dir, "corrupt",
+                "rebuilt_pyramid", f"{rows} level-0 rows resynced",
+            )
+        else:
+            for it in issues:
+                if it["action"] == "pending_rebuild":
+                    it["action"] = "found"
+
+
+# ---------------------------------------------------------------------------
+
+_REPAIRED_ACTIONS = (
+    "removed",
+    "promoted_prev",
+    "restamped",
+    "rewritten",
+    "rebuilt_pyramid",
+)
+
+
+def audit(folder, repair: bool = True, rebuild: bool = True) -> dict:
+    """Scan (and with ``repair=True`` fix) every durable artifact in
+    ``folder``.  Returns the report dict (see the module docstring);
+    ``report["clean"]`` is True when nothing is left in a state a
+    verified read would reject."""
+    from tpudas.obs.health import HEALTH_FILENAME, validate_health
+    from tpudas.io.index import INDEX_FILENAME
+    from tpudas.resilience.quarantine import QUARANTINE_FILENAME
+
+    folder = str(folder)
+    t0 = time.perf_counter()
+    issues: list = []
+    with span("integrity.audit", folder=folder):
+        if os.path.isdir(folder):
+            _sweep_tmp(folder, issues, repair)
+            _check_carry(folder, issues, repair)
+            _check_json_artifact(
+                os.path.join(folder, QUARANTINE_FILENAME), "quarantine",
+                issues, repair,
+            )
+            _check_json_artifact(
+                os.path.join(folder, HEALTH_FILENAME), "health", issues,
+                repair, validate=validate_health,
+            )
+            _check_json_artifact(
+                os.path.join(folder, INDEX_FILENAME), "index", issues,
+                repair,
+            )
+            _check_outputs(folder, issues, repair)
+            _check_pyramid(folder, issues, repair, rebuild)
+    elapsed = time.perf_counter() - t0
+    reg = get_registry()
+    reg.counter(
+        "tpudas_integrity_audit_runs_total",
+        "integrity audits (startup fsck) executed",
+    ).inc()
+    reg.histogram(
+        "tpudas_integrity_audit_seconds",
+        "wall time of one integrity audit over an output folder",
+    ).observe(elapsed)
+    counts: dict = {}
+    repaired = 0
+    for it in issues:
+        counts[it["status"]] = counts.get(it["status"], 0) + 1
+        if it["action"] in _REPAIRED_ACTIONS:
+            repaired += 1
+            reg.counter(
+                "tpudas_integrity_audit_repairs_total",
+                "artifacts repaired by the integrity audit",
+                labelnames=("kind",),
+            ).inc(kind=it["action"])
+    clean = all(it["action"] in _REPAIRED_ACTIONS for it in issues)
+    report = {
+        "folder": folder,
+        "repair": bool(repair),
+        "clean": bool(clean),
+        "elapsed_s": round(elapsed, 4),
+        "repaired": repaired,
+        "counts": counts,
+        "issues": issues,
+    }
+    if issues:
+        log_event(
+            "integrity_audit",
+            folder=folder,
+            clean=clean,
+            repaired=repaired,
+            counts=counts,
+        )
+    return report

@@ -1,0 +1,269 @@
+"""crc32 stamping and verification for every durable tpudas artifact.
+
+Two formats, chosen by what the artifact already is:
+
+- **JSON artifacts** (health.json, quarantine ledger, pyramid
+  manifest, directory-index cache, carry sidecar) embed the digest as
+  a top-level ``"_crc32"`` key computed over the **canonical** dump of
+  the rest of the object (sorted keys, no whitespace).  The stamp
+  survives any JSON re-serialization, costs no extra file, and readers
+  that don't verify simply see one extra key.
+- **Binary artifacts** (the carry ``.npz``, pyramid tiles and
+  ``tails.npy``) get a sidecar ``<path>.crc`` holding
+  ``crc32 <8-hex-digest> <size>\\n``.  The sidecar is written *after*
+  the payload rename, so a crash between the two leaves a stale
+  sidecar — verification fails, the reader takes its ladder, and the
+  startup audit re-stamps the (still internally consistent, because
+  the rename was atomic) payload.
+
+A verification result is one of three strings: ``"ok"``,
+``"unstamped"`` (a legacy artifact from before this module — accepted,
+counted), or ``"mismatch"`` (bit rot / torn copy — the reader must
+fall through its degradation ladder, never trust the bytes).
+
+Every ladder step a reader takes is counted in
+``tpudas_integrity_fallback_total{artifact=...}`` AND in a process
+counter (:func:`fallback_count`) the realtime driver snapshots into
+``health.json`` (``integrity_fallbacks``/``degraded``), so recovery is
+never silent.  Verification funnels through the ``integrity.verify``
+fault-injection site, so a test can deterministically corrupt (action
+``"truncate"``) any artifact just before its verified read.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import zlib
+
+from tpudas.obs.registry import get_registry
+from tpudas.utils.atomicio import (
+    atomic_write_bytes,
+    atomic_write_text,
+)
+from tpudas.utils.logging import log_event
+
+__all__ = [
+    "CRC_KEY",
+    "SIDECAR_SUFFIX",
+    "count_fallback",
+    "count_unstamped",
+    "crc32_hex",
+    "fallback_count",
+    "read_json_verified",
+    "rotate_prev",
+    "sidecar_path",
+    "stamp_json",
+    "strip_stamp",
+    "verify_file_checksum",
+    "verify_json_obj",
+    "write_bytes_checksummed",
+    "write_json_checksummed",
+    "write_npy_checksummed",
+    "write_sidecar_for",
+]
+
+CRC_KEY = "_crc32"
+SIDECAR_SUFFIX = ".crc"
+
+
+def crc32_hex(data: bytes) -> str:
+    return format(zlib.crc32(data) & 0xFFFFFFFF, "08x")
+
+
+def _canonical(obj) -> bytes:
+    """The byte string the JSON stamp digests: sorted keys, minimal
+    separators — identical before the write and after any parse, so
+    the stamp survives re-serialization and pretty-printing."""
+    return json.dumps(
+        obj, sort_keys=True, separators=(",", ":"), default=str
+    ).encode()
+
+
+# ---------------------------------------------------------------------------
+# fallback accounting (what health.json's `integrity_fallbacks` reads)
+
+_fallbacks = 0  # process-lifetime ladder steps (all artifacts)
+
+
+def fallback_count() -> int:
+    """Verified reads (process lifetime) that rejected a primary and
+    took a degradation-ladder step.  The realtime driver snapshots a
+    per-run delta of this into ``health.json``."""
+    return _fallbacks
+
+
+def count_fallback(artifact: str, reason: str, path: str = "") -> None:
+    """One degradation-ladder step: the primary for ``artifact`` was
+    rejected (checksum mismatch, parse failure, version skew) and the
+    reader is falling through to ``.prev`` / rebuild / rewind."""
+    global _fallbacks
+    _fallbacks += 1
+    get_registry().counter(
+        "tpudas_integrity_fallback_total",
+        "verified reads that rejected the primary artifact and took a "
+        "degradation-ladder step (.prev / rebuild / rewind)",
+        labelnames=("artifact",),
+    ).inc(artifact=artifact)
+    log_event(
+        "integrity_fallback",
+        artifact=artifact,
+        reason=str(reason)[:200],
+        path=str(path),
+    )
+
+
+def count_unstamped(artifact: str) -> None:
+    """A legacy artifact without a checksum was accepted (visibility
+    only — the audit re-stamps these)."""
+    get_registry().counter(
+        "tpudas_integrity_unstamped_total",
+        "checksum-less legacy artifacts accepted by verified reads "
+        "(the startup audit re-stamps them)",
+        labelnames=("artifact",),
+    ).inc(artifact=artifact)
+
+
+def _verify_point(path: str, artifact: str | None) -> None:
+    from tpudas.resilience.faults import fault_point
+
+    fault_point("integrity.verify", path=path, artifact=artifact)
+
+
+# ---------------------------------------------------------------------------
+# embedded-digest JSON
+
+def stamp_json(obj: dict) -> dict:
+    """``obj`` plus a ``"_crc32"`` key digesting the canonical dump of
+    everything else (an existing stamp is replaced)."""
+    body = {k: v for k, v in obj.items() if k != CRC_KEY}
+    return {**body, CRC_KEY: crc32_hex(_canonical(body))}
+
+
+def verify_json_obj(obj) -> str:
+    """``"ok"`` | ``"unstamped"`` | ``"mismatch"`` for a parsed JSON
+    object."""
+    if not isinstance(obj, dict) or CRC_KEY not in obj:
+        return "unstamped"
+    body = {k: v for k, v in obj.items() if k != CRC_KEY}
+    stamp = obj[CRC_KEY]
+    return "ok" if crc32_hex(_canonical(body)) == stamp else "mismatch"
+
+
+def strip_stamp(obj: dict) -> dict:
+    return {k: v for k, v in obj.items() if k != CRC_KEY}
+
+
+def write_json_checksummed(
+    path: str, obj: dict, durable: bool | None = None, indent: int = 1
+) -> None:
+    """Atomically write ``obj`` with an embedded crc32 stamp."""
+    atomic_write_text(
+        path, json.dumps(stamp_json(obj), indent=indent) + "\n",
+        durable=durable,
+    )
+
+
+def read_json_verified(path: str, artifact: str) -> tuple[dict, str]:
+    """Parse + verify one JSON artifact: ``(payload_without_stamp,
+    status)``.  Raises whatever ``open``/``json.load`` raises (the
+    caller's ladder handles unreadable exactly like mismatched);
+    ``status`` is ``"ok"``/``"unstamped"``/``"mismatch"``.  The
+    payload is returned even on mismatch so a caller that *chooses* to
+    limp on (none do today) could."""
+    _verify_point(path, artifact)
+    with open(path) as fh:
+        obj = json.load(fh)
+    status = verify_json_obj(obj)
+    return (strip_stamp(obj) if isinstance(obj, dict) else obj), status
+
+
+# ---------------------------------------------------------------------------
+# sidecar-digest binary
+
+def sidecar_path(path: str) -> str:
+    return path + SIDECAR_SUFFIX
+
+
+def write_bytes_checksummed(
+    path: str, payload: bytes, durable: bool | None = None
+) -> None:
+    """Atomic payload write + ``<path>.crc`` sidecar (payload first —
+    a crash between the two reads as "mismatch" and the audit
+    re-stamps)."""
+    atomic_write_bytes(path, payload, durable=durable)
+    atomic_write_text(
+        sidecar_path(path),
+        f"crc32 {crc32_hex(payload)} {len(payload)}\n",
+        durable=durable,
+    )
+
+
+def write_npy_checksummed(path: str, array, durable: bool | None = None) -> (
+    None
+):
+    """Checksummed atomic raw ``.npy`` write (serialized in memory so
+    the sidecar digests exactly the bytes on disk)."""
+    import numpy as np
+
+    buf = io.BytesIO()
+    np.save(buf, np.ascontiguousarray(array))
+    write_bytes_checksummed(path, buf.getvalue(), durable=durable)
+
+
+def write_sidecar_for(path: str, durable: bool | None = None) -> str:
+    """(Re-)stamp an existing binary artifact from its current bytes —
+    the audit's repair for unstamped/stale-sidecar payloads.  Returns
+    the digest."""
+    with open(path, "rb") as fh:
+        payload = fh.read()
+    digest = crc32_hex(payload)
+    atomic_write_text(
+        sidecar_path(path), f"crc32 {digest} {len(payload)}\n",
+        durable=durable,
+    )
+    return digest
+
+
+def verify_file_checksum(path: str, artifact: str | None = None) -> str:
+    """``"ok"`` | ``"unstamped"`` | ``"mismatch"`` for a binary
+    artifact against its ``.crc`` sidecar.  Missing payload raises
+    ``FileNotFoundError`` (absence is the caller's decision, not a
+    checksum state)."""
+    _verify_point(path, artifact)
+    side = sidecar_path(path)
+    try:
+        with open(side) as fh:
+            tokens = fh.read().split()
+    except FileNotFoundError:
+        if not os.path.isfile(path):
+            raise FileNotFoundError(path)
+        return "unstamped"
+    with open(path, "rb") as fh:
+        payload = fh.read()
+    if (
+        len(tokens) != 3
+        or tokens[0] != "crc32"
+        or not tokens[2].isdigit()
+    ):
+        return "mismatch"
+    if int(tokens[2]) != len(payload) or tokens[1] != crc32_hex(payload):
+        return "mismatch"
+    return "ok"
+
+
+# ---------------------------------------------------------------------------
+# .prev rotation (payload + sidecar move together)
+
+def rotate_prev(path: str) -> bool:
+    """Rotate ``path`` (and its ``.crc`` sidecar, if any) to
+    ``path.prev`` / ``path.prev.crc`` — the double-buffer step before
+    writing a new primary.  Returns True when a primary existed."""
+    if not os.path.isfile(path):
+        return False
+    os.replace(path, path + ".prev")
+    side = sidecar_path(path)
+    if os.path.isfile(side):
+        os.replace(side, sidecar_path(path + ".prev"))
+    return True

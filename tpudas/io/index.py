@@ -14,7 +14,6 @@ reference.
 
 from __future__ import annotations
 
-import json
 import os
 
 import numpy as np
@@ -105,16 +104,29 @@ class DirectoryIndex:
         that parses but carries a foreign version is authoritative: the
         whole cache is discarded (no ``.prev`` fallback — stale-version
         records must not resurrect)."""
+        from tpudas.integrity.checksum import (
+            count_fallback,
+            count_unstamped,
+            read_json_verified,
+        )
+
         self._loaded_cache = True
         for path in (self.cache_path, self.cache_path + ".prev"):
             try:
-                with open(path) as fh:
-                    raw = json.load(fh)
+                raw, status = read_json_verified(path, "index")
             except FileNotFoundError:
                 continue
             except (OSError, ValueError):
                 # torn/corrupt snapshot: try the double buffer
+                count_fallback("index", "unparseable cache", path)
                 continue
+            if status == "mismatch":
+                # bit rot / torn copy: records may silently lie about
+                # (mtime, size), so the whole rung is rejected
+                count_fallback("index", "checksum mismatch", path)
+                continue
+            if status == "unstamped":
+                count_unstamped("index")
             if raw.get("version") != self.CACHE_VERSION:
                 self._records = {}
                 return
@@ -125,6 +137,7 @@ class DirectoryIndex:
                 }
                 return
             except (ValueError, KeyError, TypeError):
+                count_fallback("index", "bad cache records", path)
                 continue
         self._records = {}
 
@@ -133,15 +146,17 @@ class DirectoryIndex:
             "version": self.CACHE_VERSION,
             "files": {k: _record_to_json(v) for k, v in self._records.items()},
         }
-        from tpudas.utils.atomicio import atomic_write_text
+        from tpudas.integrity.checksum import (
+            rotate_prev,
+            write_json_checksummed,
+        )
 
         try:
             # rename-not-copy double buffer (the obs.health pattern):
             # the outgoing good snapshot survives as .prev for readers
             # racing this save on mounts where rename is not atomic
-            if os.path.isfile(self.cache_path):
-                os.replace(self.cache_path, self.cache_path + ".prev")
-            atomic_write_text(self.cache_path, json.dumps(payload))
+            rotate_prev(self.cache_path)
+            write_json_checksummed(self.cache_path, payload, indent=None)
         except OSError:
             pass  # read-only data dir: keep the index in memory only
 

@@ -23,7 +23,6 @@ crash the processing loop — failures are counted
 
 from __future__ import annotations
 
-import json
 import os
 import time
 
@@ -45,7 +44,10 @@ HEALTH_FILENAME = "health.json"
 PROM_FILENAME = "metrics.prom"
 # v2 (PR 3): degradation fields — consecutive_failures,
 # quarantined_files, degraded (tpudas.resilience)
-HEALTH_SCHEMA_VERSION = 2
+# v3 (PR 5): integrity fields — integrity_fallbacks (verified reads
+# that took a degradation-ladder step this run), resource_degraded
+# (disk-full writer shedding active) (tpudas.integrity)
+HEALTH_SCHEMA_VERSION = 3
 
 # keys every snapshot carries (OBSERVABILITY.md documents types/units);
 # tests schema-check against this
@@ -65,6 +67,8 @@ HEALTH_REQUIRED_KEYS = (
     "quarantined_files",
     "degraded",
     "last_error",
+    "integrity_fallbacks",
+    "resource_degraded",
 )
 
 
@@ -94,13 +98,17 @@ def write_health(folder: str, payload: dict) -> str | None:
     path = os.path.join(folder, HEALTH_FILENAME)
     try:
         validate_health(payload)
+        from tpudas.integrity.checksum import (
+            rotate_prev,
+            write_json_checksummed,
+        )
+
         # rename (not copy) the outgoing primary to .prev: a rename is
         # ~10x cheaper than a copy on overlay filesystems, and the
         # microsecond window with no primary is exactly the case
         # read_health's .prev fallback already covers
-        if os.path.isfile(path):
-            os.replace(path, path + ".prev")
-        _atomic_write_text(path, json.dumps(payload, indent=1) + "\n")
+        rotate_prev(path)
+        write_json_checksummed(path, payload)
     except Exception as exc:
         reg.counter(
             "tpudas_health_write_errors_total",
@@ -109,6 +117,10 @@ def write_health(folder: str, payload: dict) -> str | None:
         from tpudas.utils.logging import log_event
 
         log_event("health_write_failed", error=str(exc)[:200])
+        from tpudas.integrity.resource import is_resource_error, note_pressure
+
+        if is_resource_error(exc):
+            note_pressure("health", exc)
         return None
     reg.counter(
         "tpudas_health_writes_total", "health.json snapshots written"
@@ -117,16 +129,29 @@ def write_health(folder: str, payload: dict) -> str | None:
 
 
 def read_health(folder: str) -> dict | None:
-    """The last GOOD health snapshot: ``health.json``, falling back to
-    ``health.json.prev`` when the primary is torn/corrupt/absent; None
-    when neither parses."""
+    """The last GOOD health snapshot: checksum-verified
+    ``health.json``, falling back to ``health.json.prev`` when the
+    primary is torn/corrupt/absent; None when neither verifies."""
+    from tpudas.integrity.checksum import (
+        count_fallback,
+        read_json_verified,
+    )
+
     base = os.path.join(folder, HEALTH_FILENAME)
     for path in (base, base + ".prev"):
         try:
-            with open(path) as fh:
-                payload = json.load(fh)
+            payload, status = read_json_verified(path, "health")
+            if status == "mismatch":
+                raise ValueError("health checksum mismatch")
             return validate_health(payload)
-        except Exception:
+        except FileNotFoundError:
+            continue  # absence is normal (fresh folder, mid-rename)
+        except Exception as exc:
+            # torn/corrupt rung (parse failure, crc mismatch, schema
+            # skew): count the ladder step, try the next rung
+            count_fallback(
+                "health", f"{type(exc).__name__}: {str(exc)[:120]}", path
+            )
             continue
     return None
 
@@ -148,5 +173,9 @@ def write_prom(folder: str, registry=None) -> str | None:
         from tpudas.utils.logging import log_event
 
         log_event("health_write_failed", error=str(exc)[:200])
+        from tpudas.integrity.resource import is_resource_error, note_pressure
+
+        if is_resource_error(exc):
+            note_pressure("prom", exc)
         return None
     return path

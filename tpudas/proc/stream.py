@@ -16,7 +16,9 @@ stage — so each input sample is read and filtered exactly once:
 
 Crash-only property preserved: the carry serializes to ONE ``.npz``
 beside the output files (meta embedded as JSON for atomicity, written
-tmp-then-rename, plus a human-readable ``.json`` sidecar).  The save
+tmp-then-rename with a crc32 ``.crc`` sidecar and a ``.prev`` double
+buffer — tpudas.integrity — plus a human-readable checksummed
+``.json`` sidecar).  The save
 happens AFTER the round's output writes, so on a crash the carry is
 never ahead of the outputs; :func:`reconcile_outputs` deletes output
 files newer than the carry on resume (the crashed round's partial
@@ -134,8 +136,19 @@ def _opt_int(v):
 
 def save_carry(carry: StreamCarry, folder: str) -> str:
     """Atomically persist the carry beside the output files: one
-    ``.npz`` (meta embedded, tmp-then-rename) plus a readable ``.json``
-    sidecar.  Returns the npz path."""
+    crc32-stamped ``.npz`` (meta embedded, unique tmp + rename,
+    ``.crc`` sidecar) plus a readable checksummed ``.json`` sidecar.
+    The outgoing primary survives as ``.prev`` — the middle rung of
+    the verified-read ladder (:func:`load_carry`): a resume from
+    ``.prev`` is one round back, and :func:`reconcile_outputs`
+    regenerates that round byte-identically.  Returns the npz path."""
+    import io as _io
+
+    from tpudas.integrity.checksum import (
+        rotate_prev,
+        write_bytes_checksummed,
+        write_json_checksummed,
+    )
     from tpudas.resilience.faults import fault_point
 
     path = os.path.join(folder, CARRY_FILENAME)
@@ -146,15 +159,13 @@ def save_carry(carry: StreamCarry, folder: str) -> str:
             arrays[f"buf_{i}"] = np.asarray(b, np.float32)
         if carry.residual is not None:
             arrays["residual"] = np.asarray(carry.residual, np.float32)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as fh:
-            np.savez(fh, **arrays)
-        os.replace(tmp, path)
-        side = os.path.join(folder, CARRY_SIDECAR)
-        tmp = side + ".tmp"
-        with open(tmp, "w") as fh:
-            json.dump(carry._meta(), fh, indent=1)
-        os.replace(tmp, side)
+        buf = _io.BytesIO()
+        np.savez(buf, **arrays)
+        rotate_prev(path)
+        write_bytes_checksummed(path, buf.getvalue())
+        write_json_checksummed(
+            os.path.join(folder, CARRY_SIDECAR), carry._meta()
+        )
     get_registry().counter(
         "tpudas_stream_carry_saves_total", "stream carry persists"
     ).inc()
@@ -169,11 +180,18 @@ def discard_carry(folder: str) -> bool:
     carry would reconcile away valid (possibly irreplaceable) output
     files.  Returns True when a carry was removed."""
     removed = False
-    for name in (CARRY_FILENAME, CARRY_SIDECAR):
+    for name in (
+        CARRY_FILENAME,
+        CARRY_FILENAME + ".crc",
+        CARRY_FILENAME + ".prev",
+        CARRY_FILENAME + ".prev.crc",
+        CARRY_SIDECAR,
+    ):
         path = os.path.join(folder, name)
         if os.path.isfile(path):
             os.remove(path)
-            removed = True
+            if name in (CARRY_FILENAME, CARRY_FILENAME + ".prev"):
+                removed = True
     if removed:
         log_event("stream_carry_discarded", folder=folder)
         get_registry().counter(
@@ -183,56 +201,97 @@ def discard_carry(folder: str) -> bool:
     return removed
 
 
-def load_carry(folder: str) -> StreamCarry | None:
-    """Load a previously saved carry, or None when absent or
-    unreadable (a corrupt carry must degrade to rewind mode, never
-    crash the realtime loop)."""
-    path = os.path.join(folder, CARRY_FILENAME)
-    if not os.path.isfile(path):
-        return None
-    try:
-        with np.load(path) as f:
-            meta = json.loads(str(f["meta"]))
-            if meta.get("version") != _VERSION:
-                log_event("stream_carry_version_skew", meta=meta)
-                return None
-            bufs = tuple(
-                f[f"buf_{i}"] for i in range(int(meta["n_bufs"]))
+def _parse_carry(path: str) -> StreamCarry:
+    """Parse one carry ``.npz`` into a :class:`StreamCarry`, raising
+    on ANY defect (unreadable zip, bad meta JSON, version skew,
+    missing keys).  Shared by the :func:`load_carry` ladder and the
+    startup audit — everything, including the ``StreamCarry``
+    construction, happens under the caller's try so a truncated meta
+    can never escape as a bare ``KeyError`` and kill the driver."""
+    with np.load(path) as f:
+        meta = json.loads(str(f["meta"]))
+        if meta.get("version") != _VERSION:
+            raise ValueError(
+                f"carry version skew: {meta.get('version')!r} != "
+                f"{_VERSION}"
             )
-            residual = f["residual"] if "residual" in f else None
-    except Exception as exc:
-        log_event("stream_carry_unreadable", error=str(exc)[:200])
-        get_registry().counter(
-            "tpudas_stream_carry_unreadable_total",
-            "corrupt/unreadable carries degraded to rewind mode",
-        ).inc()
-        return None
-    get_registry().counter(
-        "tpudas_stream_carry_loads_total", "stream carries loaded"
-    ).inc()
-    return StreamCarry(
-        start_ns=meta["start_ns"],
-        step_ns=meta["step_ns"],
-        dt_out=meta["dt_out"],
-        buff_out=meta["buff_out"],
-        order=meta["order"],
-        engine_req=meta["engine_req"],
-        patch_out=meta["patch_out"],
-        kind=meta["kind"],
-        d_ns=meta["d_ns"],
-        n_ch=meta["n_ch"],
-        ratio=meta["ratio"],
-        edge_in=meta["edge_in"],
-        bufs=bufs,
-        residual=residual,
-        skip_left=meta["skip_left"],
-        next_ingest_ns=meta["next_ingest_ns"],
-        next_emit_ns=meta["next_emit_ns"],
-        last_emit_ns=meta["last_emit_ns"],
-        consumed=meta["consumed"],
-        emitted=meta["emitted"],
-        pallas_ok=bool(meta.get("pallas_ok", True)),
+        bufs = tuple(f[f"buf_{i}"] for i in range(int(meta["n_bufs"])))
+        residual = f["residual"] if "residual" in f else None
+        return StreamCarry(
+            start_ns=meta["start_ns"],
+            step_ns=meta["step_ns"],
+            dt_out=meta["dt_out"],
+            buff_out=meta["buff_out"],
+            order=meta["order"],
+            engine_req=meta["engine_req"],
+            patch_out=meta["patch_out"],
+            kind=meta["kind"],
+            d_ns=meta["d_ns"],
+            n_ch=meta["n_ch"],
+            ratio=meta["ratio"],
+            edge_in=meta["edge_in"],
+            bufs=bufs,
+            residual=residual,
+            skip_left=meta["skip_left"],
+            next_ingest_ns=meta["next_ingest_ns"],
+            next_emit_ns=meta["next_emit_ns"],
+            last_emit_ns=meta["last_emit_ns"],
+            consumed=meta["consumed"],
+            emitted=meta["emitted"],
+            pallas_ok=bool(meta.get("pallas_ok", True)),
+        )
+
+
+def load_carry(folder: str) -> StreamCarry | None:
+    """Load a previously saved carry through the verified-read ladder:
+    checksum-verified primary, then the ``.prev`` double buffer (one
+    round back — :func:`reconcile_outputs` regenerates that round
+    byte-identically), then None (the driver degrades to rewind mode).
+    A corrupt carry must never crash the realtime loop; every rejected
+    rung is counted (``tpudas_integrity_fallback_total``)."""
+    from tpudas.integrity.checksum import (
+        count_fallback,
+        count_unstamped,
+        verify_file_checksum,
     )
+
+    path = os.path.join(folder, CARRY_FILENAME)
+    prev = path + ".prev"
+    if not os.path.isfile(path) and not os.path.isfile(prev):
+        return None
+    for cand in (path, prev):
+        if not os.path.isfile(cand):
+            if cand == path:
+                # a primary missing while .prev exists is the crash
+                # window between the save's rotate and write
+                count_fallback("carry", "primary missing", cand)
+            continue
+        try:
+            status = verify_file_checksum(cand, artifact="carry")
+            if status == "mismatch":
+                raise ValueError("carry checksum mismatch")
+            if status == "unstamped":
+                count_unstamped("carry")
+            carry = _parse_carry(cand)
+        except Exception as exc:
+            log_event(
+                "stream_carry_unreadable", path=cand,
+                error=f"{type(exc).__name__}: {str(exc)[:200]}",
+            )
+            get_registry().counter(
+                "tpudas_stream_carry_unreadable_total",
+                "corrupt/unreadable carries degraded to .prev or "
+                "rewind mode",
+            ).inc()
+            count_fallback(
+                "carry", f"{type(exc).__name__}: {str(exc)[:120]}", cand
+            )
+            continue
+        get_registry().counter(
+            "tpudas_stream_carry_loads_total", "stream carries loaded"
+        ).inc()
+        return carry
+    return None
 
 
 def reconcile_outputs(folder: str, carry: StreamCarry) -> int:

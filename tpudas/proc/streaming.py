@@ -69,19 +69,45 @@ class _EdgeHealth:
     the ``health.json`` payload (schema: tpudas.obs.health) and drops
     it — plus the Prometheus exposition — beside the stream carry
     every round.  Enabled by ``TPUDAS_HEALTH=1`` (or the driver's
-    ``health=True``); write failures are counted and swallowed."""
+    ``health=True``); write failures are counted and swallowed.
+
+    Integrity fields (schema v3): ``integrity_fallbacks`` is the
+    per-run count of verified reads that rejected a primary artifact
+    and took a degradation-ladder step; ``resource_degraded`` mirrors
+    the disk-full shedding flag.  Either condition marks the snapshot
+    ``degraded`` — recovery happened (or writers are shed), the
+    operator should know.  Under resource pressure ``metrics.prom`` is
+    shed (counted) while ``health.json`` itself keeps being written:
+    it is the operator's only window into the degradation."""
 
     def __init__(self, folder, enabled, boundary=None):
+        from tpudas.integrity.checksum import fallback_count
+
         self.folder = folder
         self.enabled = enabled
         self.boundary = boundary  # FaultBoundary (degradation fields)
         self.carry_resumes = 0
         self.last_error = None
+        self._fb0 = fallback_count()  # run baseline for the delta
+
+    def integrity_fallbacks(self) -> int:
+        from tpudas.integrity.checksum import fallback_count
+
+        return fallback_count() - self._fb0
 
     def write(self, counters, rounds, polls, mode, round_rt, head_lag):
         if not self.enabled:
             return
+        from tpudas.integrity import resource as _resource
+
         b = self.boundary
+        fallbacks = self.integrity_fallbacks()
+        res_degraded = _resource.is_degraded()
+        degraded = (
+            (False if b is None else b.degraded)
+            or res_degraded
+            or fallbacks > 0
+        )
         write_health(
             self.folder,
             {
@@ -100,12 +126,45 @@ class _EdgeHealth:
                 "quarantined_files": (
                     0 if b is None else b.quarantined_count
                 ),
-                "degraded": False if b is None else b.degraded,
+                "degraded": degraded,
+                "integrity_fallbacks": fallbacks,
+                "resource_degraded": res_degraded,
                 "last_error": self.last_error
                 or (None if b is None else b.last_error),
             },
         )
-        write_prom(self.folder)
+        if not _resource.should_shed("prom"):
+            write_prom(self.folder)
+
+
+def _startup_audit(output_folder) -> None:
+    """The drivers' pre-first-round fsck (tpudas.integrity.audit):
+    sweep stale tmp files, verify every durable artifact, repair via
+    the .prev/rebuild ladder.  Disable with
+    ``TPUDAS_INTEGRITY_AUDIT=0``.  Never raises — an audit failure
+    must not take down the stream it protects (counted + logged)."""
+    if os.environ.get("TPUDAS_INTEGRITY_AUDIT", "1") == "0":
+        return
+    try:
+        from tpudas.integrity.audit import audit
+
+        report = audit(output_folder, repair=True)
+        if report["issues"]:
+            print(
+                f"Integrity audit repaired {report['repaired']} "
+                f"artifact(s) in {output_folder} "
+                f"(clean={report['clean']})"
+            )
+    except Exception as exc:
+        get_registry().counter(
+            "tpudas_integrity_audit_errors_total",
+            "startup integrity audits that raised (swallowed)",
+        ).inc()
+        log_event(
+            "integrity_audit_failed",
+            folder=str(output_folder),
+            error=f"{type(exc).__name__}: {str(exc)[:200]}",
+        )
 
 
 def _append_pyramid(output_folder, rnd, emitted, state) -> None:
@@ -124,7 +183,7 @@ def _append_pyramid(output_folder, rnd, emitted, state) -> None:
     is the only durable state.  A pyramid failure is counted and
     swallowed: the read side degrades (the query engine falls back to
     full-resolution files), the write side must not."""
-    from tpudas.serve.tiles import append_patches
+    from tpudas.serve.tiles import CorruptStoreError, append_patches
 
     reg = get_registry()
     t0 = _time.perf_counter()
@@ -145,6 +204,26 @@ def _append_pyramid(output_folder, rnd, emitted, state) -> None:
             round=rnd,
             error=f"{type(exc).__name__}: {str(exc)[:200]}",
         )
+        from tpudas.integrity import resource as _resource
+
+        if _resource.is_resource_error(exc):
+            # disk full: flip the shedding flag so the NEXT rounds
+            # skip the append instead of re-failing it
+            _resource.note_pressure("pyramid", exc)
+        elif isinstance(exc, CorruptStoreError):
+            # the store itself is bad (torn tails, checksum-failed
+            # tile): the ladder's last rung — delete + rebuild from
+            # the output files, byte-identical, mid-run
+            from tpudas.serve.tiles import rebuild_pyramid
+
+            try:
+                rebuild_pyramid(output_folder)
+            except Exception as exc2:
+                log_event(
+                    "pyramid_rebuild_failed",
+                    round=rnd,
+                    error=f"{type(exc2).__name__}: {str(exc2)[:200]}",
+                )
         return
     reg.histogram(
         "tpudas_serve_pyramid_append_seconds",
@@ -353,10 +432,19 @@ def run_lowpass_realtime(
     if health is None:
         health = os.environ.get("TPUDAS_HEALTH", "0") == "1"
     policy = fault_policy if fault_policy is not None else RetryPolicy()
+    # carry/ledger/health/pyramid all live in the output folder; it
+    # must exist before the first processing round creates it
+    os.makedirs(output_folder, exist_ok=True)
+    # startup fsck BEFORE any persisted state (ledger, carry, pyramid)
+    # is loaded: stale tmp sweep, checksum verification, .prev
+    # promotion, pyramid rebuild — see tpudas.integrity.audit
+    _startup_audit(output_folder)
+    from tpudas.integrity import resource as _resource
+
+    if _resource.is_degraded():
+        # stale in-process pressure from a previous run: re-probe now
+        _resource.probe_recovery(output_folder)
     if quarantine:
-        # the ledger lives beside the carry; the folder must exist even
-        # if the first processing round has not created it yet
-        os.makedirs(output_folder, exist_ok=True)
         ledger = QuarantineLedger(output_folder)
     else:
         ledger = None
@@ -657,7 +745,7 @@ def run_lowpass_realtime(
                             "stream-seconds between the fiber head and the "
                             "newest emitted output",
                         ).set(head_lag)
-                    if pyramid:
+                    if pyramid and not _resource.should_shed("pyramid"):
                         _append_pyramid(
                             output_folder, rnd, emitted_patches,
                             pyr_state,
@@ -676,6 +764,11 @@ def run_lowpass_realtime(
                     processed_once = True
                 else:
                     boundary.on_success()
+                if _resource.is_degraded():
+                    # disk-full recovery probe: one tiny write — the
+                    # moment it succeeds, shed writers resume and the
+                    # pyramid backfills from the output files
+                    _resource.probe_recovery(output_folder)
                 # every poll (including an empty first one) sets the
                 # growth baseline: the next no-growth poll terminates
                 # (reference semantics — the loop ends when the spool
@@ -781,6 +874,7 @@ def run_rolling_realtime(
             f"{tuple(mesh.shape)}"
         )
     os.makedirs(output_folder, exist_ok=True)
+    _startup_audit(output_folder)
     interval = float(poll_interval) if poll_interval is not None else float(
         file_duration
     )

@@ -13,6 +13,14 @@ Taxonomy (:func:`classify_failure`):
   offending file in the quarantine ledger; after
   ``RetryPolicy.quarantine_after`` strikes the file is excluded from
   the spool index and the round proceeds without it.
+- ``"resource"`` — the disk (or quota) is full: ``OSError`` with
+  ``ENOSPC``/``EDQUOT``.  Retried like a transient but with extra
+  patience (``max_consecutive * resource_patience`` attempts — a full
+  disk usually clears when rotation kicks in, and dying does not free
+  space); the boundary additionally flips the process-wide pressure
+  flag (:mod:`tpudas.integrity.resource`) so the driver sheds
+  non-essential writers (pyramid append, metrics.prom) until a probe
+  write succeeds again.
 - ``"fatal"`` — configuration or programming errors (``TypeError``,
   ``ValueError`` outside a file read, the reference's ``on_gap="raise"``
   gap exception).  Retrying cannot help; these propagate immediately,
@@ -37,11 +45,21 @@ block).  Production code marks its fault sites with
 - ``"serve.tile_read"`` — per-tile pyramid read (tpudas/serve/tiles.py);
 - ``"serve.queue_full"`` — the HTTP admission gate (tpudas/serve/http.py):
   an injected fault here reads as "gate saturated", so load-shed paths
-  are testable without racing real threads.
+  are testable without racing real threads;
+- ``"integrity.verify"`` — the head of every verified artifact read
+  (tpudas/integrity/checksum.py): ``action="truncate"`` here corrupts
+  the artifact an instant before its checksum check, so every
+  degradation ladder is drillable byte-for-byte;
+- ``"fs.write_enospc"`` — every atomic state write
+  (tpudas/utils/atomicio.py) plus the recovery probe
+  (tpudas/integrity/resource.py): raise ``OSError(ENOSPC)`` here (see
+  ``tpudas.testing.enospc_error``) and the process experiences a full
+  disk, degradation ladder included.
 """
 
 from __future__ import annotations
 
+import errno
 import os
 import time
 from dataclasses import dataclass, field
@@ -83,15 +101,21 @@ class SpoolReadError(Exception):
         self.original = original
 
 
+RESOURCE_ERRNOS = (errno.ENOSPC, errno.EDQUOT)
+
+
 def classify_failure(exc: BaseException) -> str:
-    """``"transient"`` | ``"corrupt"`` | ``"fatal"`` for one exception.
+    """``"transient"`` | ``"corrupt"`` | ``"resource"`` | ``"fatal"``
+    for one exception.
 
     A :class:`SpoolReadError` wrapping an ``OSError`` is transient (the
     interrogator may still be flushing the file); wrapping anything
     else it is corrupt (the bytes decoded wrong — rereading the same
-    bytes cannot fix that, only quarantine can).  A bare ``OSError``
-    anywhere else in the round is transient.  Everything else — config,
-    programming, the reference's gap raise — is fatal.
+    bytes cannot fix that, only quarantine can).  An ``OSError`` with
+    ``ENOSPC``/``EDQUOT`` is resource (the OUTPUT side is full —
+    retrying with shed writers beats dying); any other bare ``OSError``
+    in the round is transient.  Everything else — config, programming,
+    the reference's gap raise — is fatal.
     """
     if isinstance(exc, SpoolReadError):
         return (
@@ -100,6 +124,8 @@ def classify_failure(exc: BaseException) -> str:
     if isinstance(exc, MemoryError):
         return "fatal"
     if isinstance(exc, OSError):
+        if getattr(exc, "errno", None) in RESOURCE_ERRNOS:
+            return "resource"
         return "transient"
     return "fatal"
 
@@ -122,6 +148,9 @@ class RetryPolicy:
     seed: int = 0
     quarantine_after: int = 3  # per-file strikes before quarantine
     quarantine_retry: float = 900.0  # slow-schedule probe interval (s)
+    # resource (disk-full) failures get max_consecutive * this before
+    # propagating: exiting cannot free space, waiting for rotation can
+    resource_patience: int = 8
     clock: object = time.time  # injectable for deterministic tests
 
     def delay(self, attempt: int) -> float:
@@ -245,18 +274,27 @@ class FaultBoundary:
         ).inc(kind=kind)
         if isinstance(exc, SpoolReadError):
             self._charge_file(exc.path, self.last_error)
+        if kind == "resource":
+            # flip the process-wide pressure flag: the driver sheds
+            # non-essential writers until a probe write succeeds
+            from tpudas.integrity.resource import note_pressure
+
+            note_pressure(where, exc)
         if kind == "fatal":
             decision = FaultDecision(kind, True, reason="fatal failure")
         else:
             self.consecutive += 1
             self._gauges()
-            if self.consecutive > self.policy.max_consecutive:
+            limit = self.policy.max_consecutive
+            if kind == "resource":
+                limit *= max(int(self.policy.resource_patience), 1)
+            if self.consecutive > limit:
                 decision = FaultDecision(
                     kind,
                     True,
                     reason=(
                         f"{self.consecutive} consecutive round failures "
-                        f"(max {self.policy.max_consecutive})"
+                        f"(max {limit})"
                     ),
                 )
             else:
@@ -336,6 +374,8 @@ FAULT_SITES = (
     "carry.save",
     "serve.tile_read",
     "serve.queue_full",
+    "integrity.verify",
+    "fs.write_enospc",
 )
 
 _ACTIONS = ("raise", "truncate", "delay")

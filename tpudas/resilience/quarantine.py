@@ -26,7 +26,6 @@ never a reason to die.
 
 from __future__ import annotations
 
-import json
 import os
 import time
 
@@ -56,35 +55,66 @@ class QuarantineLedger:
         return os.path.join(self.folder, QUARANTINE_FILENAME)
 
     def _load(self) -> None:
-        if not os.path.isfile(self.path):
+        """Verified-read ladder: checksummed primary, then the
+        ``.prev`` double buffer, then empty (counted) — quarantine is
+        an optimization, never a reason to die."""
+        from tpudas.integrity.checksum import (
+            count_fallback,
+            count_unstamped,
+            read_json_verified,
+        )
+
+        primary = self.path
+        if not os.path.isfile(primary) and not os.path.isfile(
+            primary + ".prev"
+        ):
             return
-        try:
-            with open(self.path) as fh:
-                raw = json.load(fh)
-            if raw.get("version") != _VERSION:
-                log_event("quarantine_version_skew", got=raw.get("version"))
+        for cand in (primary, primary + ".prev"):
+            try:
+                raw, status = read_json_verified(cand, "quarantine")
+                if status == "mismatch":
+                    raise ValueError("ledger checksum mismatch")
+                if status == "unstamped":
+                    count_unstamped("quarantine")
+                if raw.get("version") != _VERSION:
+                    log_event(
+                        "quarantine_version_skew", got=raw.get("version")
+                    )
+                    return
+                files = raw.get("files", {})
+                if not isinstance(files, dict):
+                    raise ValueError("files is not a mapping")
+                self._entries = {
+                    str(k): dict(v) for k, v in files.items()
+                }
                 return
-            files = raw.get("files", {})
-            if not isinstance(files, dict):
-                raise ValueError("files is not a mapping")
-            self._entries = {str(k): dict(v) for k, v in files.items()}
-        except (OSError, ValueError, TypeError, AttributeError) as exc:
-            # a torn/corrupt ledger must degrade to empty, never crash
-            # the driver it protects
-            log_event("quarantine_ledger_unreadable", error=str(exc)[:200])
-            get_registry().counter(
-                "tpudas_quarantine_ledger_unreadable_total",
-                "corrupt quarantine ledgers degraded to empty",
-            ).inc()
-            self._entries = {}
+            except FileNotFoundError:
+                continue
+            except (OSError, ValueError, TypeError, AttributeError) as exc:
+                # a torn/corrupt rung falls through the ladder
+                log_event(
+                    "quarantine_ledger_unreadable", path=cand,
+                    error=str(exc)[:200],
+                )
+                get_registry().counter(
+                    "tpudas_quarantine_ledger_unreadable_total",
+                    "corrupt quarantine ledgers degraded to .prev or "
+                    "empty",
+                ).inc()
+                count_fallback("quarantine", str(exc)[:120], cand)
+                continue
+        self._entries = {}
 
     def _save(self) -> None:
+        from tpudas.integrity.checksum import (
+            rotate_prev,
+            write_json_checksummed,
+        )
+
         payload = {"version": _VERSION, "files": self._entries}
-        tmp = self.path + ".tmp"
         try:
-            with open(tmp, "w") as fh:
-                json.dump(payload, fh, indent=1)
-            os.replace(tmp, self.path)
+            rotate_prev(self.path)
+            write_json_checksummed(self.path, payload)
         except OSError as exc:
             # read-only output dir: ledger stays in-memory for this run
             log_event("quarantine_ledger_write_failed", error=str(exc)[:200])

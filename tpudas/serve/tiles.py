@@ -62,16 +62,23 @@ identical to a one-shot rebuild from the same output files.
 
 from __future__ import annotations
 
-import json
 import os
 from dataclasses import dataclass, field
 
 import numpy as np
 
 from tpudas.core.timeutils import to_datetime64
+from tpudas.integrity.checksum import (
+    count_fallback,
+    count_unstamped,
+    read_json_verified,
+    rotate_prev,
+    verify_file_checksum,
+    write_json_checksummed,
+    write_npy_checksummed,
+)
 from tpudas.obs.registry import get_registry
 from tpudas.resilience.faults import fault_point
-from tpudas.utils.atomicio import atomic_write_text as _atomic_write_text
 from tpudas.utils.logging import log_event
 
 __all__ = [
@@ -83,6 +90,7 @@ __all__ = [
     "TileStore",
     "append_patches",
     "block_reduce",
+    "rebuild_pyramid",
     "sync_pyramid",
 ]
 
@@ -106,14 +114,48 @@ _DEFAULT_TILE_LEN = 256
 _STORE_DTYPE = np.float32
 
 
-def _atomic_write_npy(path: str, array: np.ndarray) -> None:
-    """Atomic raw ``.npy`` write (``np.save`` appends ``.npy`` to bare
-    string paths, so the tmp file is written through an open
-    handle)."""
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as fh:
-        np.save(fh, np.ascontiguousarray(array))
-    os.replace(tmp, path)
+def rebuild_pyramid(folder, engine=None, factor=None, tile_len=None) -> int:
+    """The degradation ladder's last pyramid rung: delete ``.tiles/``
+    and rebuild it from the output files via :func:`sync_pyramid` —
+    byte-identical to the incremental build, because the reduction is
+    deterministic.  The original ``factor``/``tile_len`` are recovered
+    from whatever manifest rung still parses (the geometry must
+    survive the rebuild, or the "byte-identical" claim breaks); env
+    defaults apply only when nothing is recoverable.  Returns the
+    number of level-0 rows in the rebuilt pyramid."""
+    import json as _json
+    import shutil
+
+    tiles_dir = os.path.join(str(folder), TILE_DIRNAME)
+    if factor is None or tile_len is None:
+        store = TileStore.open(folder)
+        if store is not None:
+            factor = factor or store.factor
+            tile_len = tile_len or store.tile_len
+        else:
+            # last resort: a raw (checksum-ignored) parse of either
+            # manifest rung just for the two geometry fields
+            base = os.path.join(tiles_dir, MANIFEST_FILENAME)
+            for path in (base, base + ".prev"):
+                try:
+                    with open(path) as fh:
+                        raw = _json.load(fh)
+                    factor = factor or int(raw["factor"])
+                    tile_len = tile_len or int(raw["tile_len"])
+                    break
+                except (OSError, ValueError, KeyError, TypeError):
+                    continue
+    if os.path.isdir(tiles_dir):
+        shutil.rmtree(tiles_dir, ignore_errors=True)
+    get_registry().counter(
+        "tpudas_serve_pyramid_rebuilds_total",
+        "tile pyramids deleted and rebuilt from the output files "
+        "(corrupt-store recovery)",
+    ).inc()
+    log_event("pyramid_rebuilt", folder=str(folder))
+    return sync_pyramid(
+        folder, factor=factor, tile_len=tile_len, engine=engine
+    )
 
 
 def block_reduce(x, factor: int, op: str, engine=None) -> np.ndarray:
@@ -269,8 +311,11 @@ class TileStore:
                     stat_key = (st.st_mtime_ns, st.st_size)
                 except OSError:
                     stat_key = None
-                with open(path) as fh:
-                    raw = json.load(fh)
+                raw, status = read_json_verified(path, "manifest")
+                if status == "mismatch":
+                    raise ValueError("manifest checksum mismatch")
+                if status == "unstamped":
+                    count_unstamped("manifest")
                 if raw.get("version") != MANIFEST_VERSION:
                     raise ValueError(
                         f"unknown pyramid manifest version "
@@ -297,6 +342,11 @@ class TileStore:
                     "pyramid manifests that failed to parse (fell back "
                     "to .prev or empty)",
                 ).inc()
+                count_fallback(
+                    "manifest",
+                    f"{type(exc).__name__}: {str(exc)[:120]}",
+                    path,
+                )
                 log_event(
                     "pyramid_manifest_unreadable",
                     path=path,
@@ -341,10 +391,9 @@ class TileStore:
         path = self.manifest_path
         # rename-not-copy double buffer, same as health.json: the
         # outgoing good manifest survives as .prev for torn-read
-        # readers
-        if os.path.isfile(path):
-            os.replace(path, path + ".prev")
-        _atomic_write_text(path, json.dumps(payload, indent=1) + "\n")
+        # readers; the write carries an embedded crc32 stamp
+        rotate_prev(path)
+        write_json_checksummed(path, payload)
         # our in-memory state IS this manifest: stat-gate so a writer
         # held across rounds never re-parses its own save
         try:
@@ -409,6 +458,13 @@ class TileStore:
         path = self.tails_path
         if os.path.isfile(path):
             fault_point("serve.tile_read", path=path)
+            if verify_file_checksum(path, artifact="tails") == "mismatch":
+                count_fallback("tails", "checksum mismatch", path)
+                raise CorruptStoreError(
+                    f"pyramid tails file {path!r} failed its crc32 "
+                    f"check — delete {TILE_DIRNAME}/ (or run "
+                    "tools/fsck.py) to rebuild"
+                )
             try:
                 flat = np.load(path)
                 k = int(round(float(flat[0])))
@@ -436,6 +492,10 @@ class TileStore:
             except (ValueError, IndexError) as exc:
                 # a torn/garbled tails file is SERVER-side corruption,
                 # not a caller mistake
+                count_fallback(
+                    "tails", f"{type(exc).__name__}: {str(exc)[:120]}",
+                    path,
+                )
                 raise CorruptStoreError(
                     f"unreadable pyramid tails file {path!r}: "
                     f"{type(exc).__name__}: {exc} — delete "
@@ -475,7 +535,7 @@ class TileStore:
             np.concatenate([header] + chunks) if chunks else header
         )
         os.makedirs(self.tiles_dir, exist_ok=True)
-        _atomic_write_npy(self.tails_path, payload)
+        write_npy_checksummed(self.tails_path, payload)
 
     def _tail_for(self, level: int, tile_idx: int, rows: int):
         """The tails entry for ``tile_idx`` of ``level`` when it
@@ -505,6 +565,7 @@ class TileStore:
             return arr[keep]
         path = self.tile_path(level, tile_idx)
         if os.path.isfile(path):
+            self._verify_tile(path)
             arr = np.load(path)
             if arr.shape[row_ax] >= off:
                 return arr[keep]
@@ -535,12 +596,31 @@ class TileStore:
             # fall through: a crashed-future complete tile file covers
             # the partial index (its prefix is byte-identical)
         fault_point("serve.tile_read", path=path)
+        self._verify_tile(path)
         arr = np.load(path)
         get_registry().counter(
             "tpudas_serve_tile_loads_total",
             "pyramid tile files loaded from disk",
         ).inc()
         return self._tile_dict(level, arr, valid)
+
+    @staticmethod
+    def _verify_tile(path: str) -> None:
+        """Checksum gate before trusting one tile file's bytes (an
+        unstamped legacy tile is accepted — the audit re-stamps it)."""
+        try:
+            status = verify_file_checksum(path, artifact="tile")
+        except FileNotFoundError:
+            return  # absence surfaces as np.load's own error
+        if status == "mismatch":
+            count_fallback("tile", "checksum mismatch", path)
+            raise CorruptStoreError(
+                f"pyramid tile {path!r} failed its crc32 check — "
+                f"delete {TILE_DIRNAME}/ (or run tools/fsck.py) to "
+                "rebuild"
+            )
+        if status == "unstamped":
+            count_unstamped("tile")
 
     def read(self, level, lo, hi, agg="mean", loader=None) -> np.ndarray:
         """Level-``level`` samples ``[lo, hi)`` of one aggregate as a
@@ -603,7 +683,7 @@ class TileStore:
         for j in range(n_full):
             sl = (slice(None),) * row_ax + (slice(j * tl, (j + 1) * tl),)
             tile = np.ascontiguousarray(combined[sl])
-            _atomic_write_npy(self.tile_path(level, base + j), tile)
+            write_npy_checksummed(self.tile_path(level, base + j), tile)
             self._wcache[(level, base + j)] = tile
         sl = (slice(None),) * row_ax + (slice(n_full * tl, rows_comb),)
         rem = np.ascontiguousarray(combined[sl])

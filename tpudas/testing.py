@@ -11,16 +11,19 @@ Fault injection (re-exported from :mod:`tpudas.resilience.faults` —
 the hooks live there so production IO modules never import this
 module): build a :class:`FaultPlan` of :class:`FaultSpec` entries
 (raise / truncate / delay at the named :data:`FAULT_SITES` — spool
-read, index update, round body, carry save) and scope it with
+read, index update, round body, carry save, serve tile read / queue
+full, integrity verify, fs write ENOSPC) and scope it with
 :func:`install_fault_plan`; every degradation path in the realtime
 drivers is then exercisable deterministically.
 :func:`write_corrupt_file` fabricates the classic bad input — a file
 with valid HDF5 magic and garbage after it (a truncated interrogator
-flush).
+flush); :func:`enospc_error` is the ready-made full-disk ``OSError``
+for the ``fs.write_enospc`` site.
 """
 
 from __future__ import annotations
 
+import errno
 import os
 
 import numpy as np
@@ -41,6 +44,7 @@ __all__ = [
     "make_synthetic_spool",
     "lowfreq_truth",
     "write_corrupt_file",
+    "enospc_error",
     "FAULT_SITES",
     "FaultPlan",
     "FaultSpec",
@@ -53,6 +57,14 @@ DEFAULT_T0 = "2023-03-22T00:00:00"
 # the HDF5 signature — a half-written interrogator file usually has a
 # valid header and garbage (or nothing) after it
 _HDF5_MAGIC = b"\x89HDF\r\n\x1a\n"
+
+
+def enospc_error(msg: str = "injected: no space left on device") -> OSError:
+    """An ``OSError`` carrying ``errno.ENOSPC`` — pass as
+    ``FaultSpec("fs.write_enospc", exc=enospc_error())`` to simulate a
+    full disk at any atomic state write (the taxonomy classifies it
+    ``"resource"`` and the driver sheds non-essential writers)."""
+    return OSError(errno.ENOSPC, msg)
 
 
 def write_corrupt_file(path, nbytes=512, seed=0) -> str:

@@ -1,33 +1,126 @@
-"""Atomic file-write primitives shared by the snapshot writers.
+"""Atomic file-write primitives shared by every durable-state writer.
 
 One home for the tmp-then-``os.replace`` discipline that
-``health.json`` / ``metrics.prom`` (tpudas.obs.health), the tile
-pyramid's manifest/tails (tpudas.serve.tiles), and the directory-index
+``health.json`` / ``metrics.prom`` (tpudas.obs.health), the stream
+carry (tpudas.proc.stream), the quarantine ledger
+(tpudas.resilience.quarantine), the tile pyramid's
+manifest/tails/tiles (tpudas.serve.tiles), and the directory-index
 cache (tpudas.io.index) all rely on: readers never see a partial
-file.  Deliberately no fsync — these are snapshots rewritten every
-round; durability across power loss is not worth milliseconds per
-round, and each caller keeps a ``.prev`` double buffer for the
-corrupt-primary case.
+file.
+
+Tmp names are **unique per process** (``<path>.tmp.<pid>``) so two
+writers racing the same destination cannot clobber each other's
+half-written tmp — each finishes its own bytes and the last
+``os.replace`` wins whole.  Stale tmp leftovers from a crashed process
+are swept by the startup audit (:func:`tpudas.integrity.audit`), which
+recognizes them via :func:`is_tmp_name`.
+
+Durability is **opt-in**: by default nothing fsyncs (these are
+snapshots rewritten every round; losing the last seconds across a
+power cut costs one rewind, not correctness — every reader has a
+``.prev``/rebuild ladder for the corrupt-primary case).  Pass
+``durable=True`` (or set ``TPUDAS_FSYNC=1``, see
+:func:`durable_default`) to fsync the payload before the rename and
+the directory after it, for deployments where the carry must survive
+power loss, not just process death.
+
+Every write funnels through the ``fs.write_enospc`` fault-injection
+site (:mod:`tpudas.resilience.faults`), so disk-full behavior is
+deterministically drillable: an injected ``OSError(ENOSPC)`` here is
+indistinguishable from the real thing to every caller.
 """
 
 from __future__ import annotations
 
 import os
+import re
 
-__all__ = ["atomic_write_text", "atomic_write_bytes"]
+__all__ = [
+    "atomic_write_text",
+    "atomic_write_bytes",
+    "durable_default",
+    "is_tmp_name",
+    "tmp_path_for",
+]
+
+# matches "<base>.tmp" (legacy single-writer names) and
+# "<base>.tmp.<pid>" (current unique names)
+_TMP_NAME_RE = re.compile(r"\.tmp(\.\d+)?$")
 
 
-def atomic_write_text(path: str, text: str) -> None:
-    """Write ``text`` to ``path`` via tmp + rename."""
-    tmp = path + ".tmp"
+def is_tmp_name(name: str) -> bool:
+    """True for the basename of an in-flight (or crashed) tmp file
+    written by this module — the startup audit's sweep predicate."""
+    return _TMP_NAME_RE.search(os.path.basename(str(name))) is not None
+
+
+def tmp_path_for(path: str) -> str:
+    """The per-process tmp name for ``path`` — unique per pid, so
+    concurrent writers to one destination never share a tmp file."""
+    return f"{path}.tmp.{os.getpid()}"
+
+
+def durable_default() -> bool:
+    """The process-wide default for ``durable=None`` writes:
+    ``TPUDAS_FSYNC=1`` turns fsync-before-rename on everywhere."""
+    return os.environ.get("TPUDAS_FSYNC", "0") == "1"
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync the directory holding ``path`` so the rename itself is
+    durable (best-effort: not every filesystem supports dir fds)."""
+    dirname = os.path.dirname(os.path.abspath(path)) or "."
+    try:
+        fd = os.open(dirname, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _fault_point(path: str) -> None:
+    # lazy import: utils must stay importable before the resilience
+    # package (and the site costs one `is None` check with no plan)
+    from tpudas.resilience.faults import fault_point
+
+    fault_point("fs.write_enospc", path=path)
+
+
+def _replace(tmp: str, path: str, durable: bool) -> None:
+    os.replace(tmp, path)
+    if durable:
+        _fsync_dir(path)
+
+
+def atomic_write_text(path: str, text: str, durable: bool | None = None) -> (
+    None
+):
+    """Write ``text`` to ``path`` via unique tmp + rename."""
+    durable = durable_default() if durable is None else bool(durable)
+    _fault_point(path)
+    tmp = tmp_path_for(path)
     with open(tmp, "w") as fh:
         fh.write(text)
-    os.replace(tmp, path)
+        if durable:
+            fh.flush()
+            os.fsync(fh.fileno())
+    _replace(tmp, path, durable)
 
 
-def atomic_write_bytes(path: str, payload: bytes) -> None:
-    """Write ``payload`` to ``path`` via tmp + rename."""
-    tmp = path + ".tmp"
+def atomic_write_bytes(path: str, payload: bytes, durable: bool | None = (
+    None
+)) -> None:
+    """Write ``payload`` to ``path`` via unique tmp + rename."""
+    durable = durable_default() if durable is None else bool(durable)
+    _fault_point(path)
+    tmp = tmp_path_for(path)
     with open(tmp, "wb") as fh:
         fh.write(payload)
-    os.replace(tmp, path)
+        if durable:
+            fh.flush()
+            os.fsync(fh.fileno())
+    _replace(tmp, path, durable)
