@@ -1,0 +1,954 @@
+"""tpudas.detect: the streaming-operator subsystem (ISSUE 6).
+
+The acceptance bar: STA/LTA + rolling-RMS operators run in both
+realtime drivers with O(1) carries that make retry == restart
+byte-identical — a kill at any detect fault site, a skipped operator
+round, or a full state reset all converge to the SAME events ledger,
+score tiles, and operator carries an uninterrupted control produces;
+``GET /events`` serves the integrity-verified results; the startup
+audit classifies and repairs every detect artifact.
+"""
+
+import hashlib
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpudas.core.timeutils import to_datetime64
+from tpudas.detect.ledger import (
+    ScoreStore,
+    event_line,
+    ledger_status_text,
+    load_events,
+    write_events,
+)
+from tpudas.detect.operators import make_operator, operator_names
+from tpudas.detect.runner import DetectPipeline, load_detect_carry
+from tpudas.integrity.audit import audit
+from tpudas.io.registry import write_patch
+from tpudas.obs.registry import MetricsRegistry, use_registry
+from tpudas.proc.streaming import run_lowpass_realtime, run_rolling_realtime
+from tpudas.resilience.faults import RetryPolicy
+from tpudas.testing import (
+    FaultPlan,
+    FaultSpec,
+    install_fault_plan,
+    make_synthetic_spool,
+    synthetic_patch,
+)
+
+T0 = "2023-03-22T00:00:00"
+FS = 50.0
+FILE_SEC = 20.0
+NCH = 4
+STEP_NS = 1_000_000_000
+
+# thresholds tuned so the noisy synthetic stream actually produces
+# ledger events (empty ledgers would make equivalence tests vacuous)
+OPS = [
+    ("stalta", {"sta": 2.0, "lta": 10.0, "on": 2.0, "off": 1.2}),
+    ("rms", {"window": 5.0, "step": 2.0, "thresh": 1.5, "baseline": 20.0}),
+]
+
+FAST = RetryPolicy(base_delay=0.0, max_delay=0.0, jitter=0.0)
+
+
+def _spool(src, n_files=2):
+    return make_synthetic_spool(
+        src, n_files=n_files, file_duration=FILE_SEC, fs=FS, n_ch=NCH,
+        noise=0.01,
+    )
+
+
+def _append_one(src, index):
+    t0 = to_datetime64(T0).astype("datetime64[ns]")
+    step = np.timedelta64(int(round(1e9 / FS)), "ns")
+    n = int(FILE_SEC * FS)
+    p = synthetic_patch(
+        t0=t0 + index * n * step, duration=FILE_SEC, fs=FS, n_ch=NCH,
+        seed=index, phase_origin=t0, noise=0.01,
+    )
+    write_patch(p, os.path.join(src, f"raw_{index:04d}.h5"))
+
+
+def _drive(src, out, feed_third=False, **kw):
+    def sleep(_):
+        if feed_third and not os.path.isfile(
+            os.path.join(src, "raw_0002.h5")
+        ):
+            _append_one(src, 2)
+
+    kw.setdefault("detect", True)
+    kw.setdefault("detect_operators", OPS)
+    kw.setdefault("pyramid", True)
+    return run_lowpass_realtime(
+        source=src,
+        output_folder=out,
+        start_time=T0,
+        output_sample_interval=1.0,
+        edge_buffer=5.0,
+        process_patch_size=20,
+        poll_interval=0.0,
+        sleep_fn=sleep,
+        fault_policy=FAST,
+        **kw,
+    )
+
+
+def _detect_sig(out):
+    """(ledger bytes sha, carry content sha, scores content sha) — the
+    crash-equivalence comparison key.  The carry is compared by parsed
+    content (the npz container embeds zip timestamps)."""
+    with open(os.path.join(out, ".detect", "events.jsonl"), "rb") as fh:
+        ledger = hashlib.sha256(fh.read()).hexdigest()
+    carry = load_detect_carry(out)
+    assert carry is not None
+    h = hashlib.sha256()
+    h.update(json.dumps(carry["meta"], sort_keys=True).encode())
+    for st in carry["states"]:
+        for key in sorted(st):
+            arr = np.asarray(st[key])
+            h.update(key.encode())
+            h.update(str(arr.dtype).encode())
+            h.update(arr.tobytes())
+    store = ScoreStore.open(out)
+    t, v = store.read()
+    scores = hashlib.sha256(t.tobytes() + v.tobytes()).hexdigest()
+    return ledger, h.hexdigest(), scores
+
+
+def _event_key(ev):
+    return (ev["t_end_ns"], ev["channel"], ev["t_ns"], ev["op"])
+
+
+# ---------------------------------------------------------------------------
+# operators
+
+
+class TestOperatorContract:
+    def test_registry(self):
+        assert "stalta" in operator_names()
+        assert "rms" in operator_names()
+        op = make_operator({"name": "stalta", "on": 5.0})
+        assert op.on == 5.0
+        assert make_operator(op) is op
+        with pytest.raises(ValueError, match="unknown detect operator"):
+            make_operator("nope")
+
+    def test_two_score_operators_rejected(self, tmp_path):
+        """The single-level score store holds ONE row track: a second
+        score-producing operator must be rejected up front, not
+        silently interleaved."""
+        with pytest.raises(ValueError, match="score-producing"):
+            DetectPipeline.open(str(tmp_path), operators=[
+                ("rms", {"window": 5.0, "step": 2.0}),
+                ("rms", {"window": 30.0, "step": 2.0}),
+            ])
+
+    def test_param_validation(self):
+        with pytest.raises(ValueError):
+            make_operator(("stalta", {"sta": 5.0, "lta": 1.0}))
+        with pytest.raises(ValueError):
+            make_operator(("stalta", {"on": 2.0, "off": 3.0}))
+        with pytest.raises(ValueError):
+            make_operator(("rms", {"window": 0.0}))
+
+    @pytest.mark.parametrize(
+        "spec",
+        [("stalta", {"sta": 2.0, "lta": 10.0, "on": 2.0, "off": 1.2}),
+         ("rms", {"window": 5.0, "step": 2.0, "thresh": 2.0,
+                  "baseline": 20.0})],
+        ids=["stalta", "rms"],
+    )
+    def test_chunk_invariance(self, spec):
+        """The contract's rule 1: any chunking of the same row stream
+        produces bit-identical events, scores, and final state."""
+        rng = np.random.default_rng(0)
+        T, C = 500, 3
+        rows = (0.1 * rng.standard_normal((T, C))).astype(np.float32)
+        rows[250:280, 1] += 5.0  # a burst
+        t_ns = np.arange(T, dtype=np.int64) * STEP_NS
+        op = make_operator(spec)
+        st_a = op.init_state(C, STEP_NS)
+        res_a, st_a = op.process(rows, t_ns, STEP_NS, st_a)
+        st_b = op.init_state(C, STEP_NS)
+        evs, scores, times = [], [], []
+        cuts = sorted(
+            rng.choice(np.arange(1, T), size=9, replace=False).tolist()
+        )
+        for lo, hi in zip([0] + cuts, cuts + [T]):
+            r, st_b = op.process(rows[lo:hi], t_ns[lo:hi], STEP_NS, st_b)
+            evs.extend(r.events)
+            if r.scores is not None and r.scores.size:
+                scores.append(r.scores)
+                times.append(r.score_t_ns)
+        assert sorted(res_a.events, key=_event_key) == sorted(
+            evs, key=_event_key
+        )
+        if res_a.scores is not None:
+            assert np.array_equal(res_a.scores, np.concatenate(scores))
+            assert np.array_equal(
+                res_a.score_t_ns, np.concatenate(times)
+            )
+        for key in st_a:
+            assert np.array_equal(
+                np.asarray(st_a[key]), np.asarray(st_b[key])
+            ), key
+
+    def test_stalta_detects_burst_and_carries_open_events(self):
+        rng = np.random.default_rng(1)
+        T, C = 400, 2
+        rows = (0.05 * rng.standard_normal((T, C))).astype(np.float32)
+        rows[200:230, 0] += 3.0
+        t_ns = np.arange(T, dtype=np.int64) * STEP_NS
+        op = make_operator(OPS[0])
+        st = op.init_state(C, STEP_NS)
+        # split INSIDE the burst so the trigger is open at the seam
+        r1, st = op.process(rows[:210], t_ns[:210], STEP_NS, st)
+        assert bool(np.asarray(st["in_event"])[0])
+        r2, st = op.process(rows[210:], t_ns[210:], STEP_NS, st)
+        trig = [e for e in r1.events + r2.events
+                if e["channel"] == 0 and e["t_ns"] >= 195 * STEP_NS]
+        assert trig, "burst trigger missing"
+        assert trig[0]["t_peak_ns"] >= trig[0]["t_ns"]
+        assert trig[0]["t_end_ns"] > trig[0]["t_ns"]
+        assert trig[0]["score"] >= op.on
+        # closed events leave a canonical (zeroed) carry — channels
+        # not currently in an event hold zeros (an open noise trigger
+        # on the other channel may legitimately ride the carry)
+        closed = ~np.asarray(st["in_event"], bool)
+        assert not np.asarray(st["peak"])[closed].any()
+        assert not np.asarray(st["t_on"])[closed].any()
+
+    def test_rms_scores_on_global_grid(self):
+        op = make_operator(OPS[1])  # w=5 rows, s=2 rows at 1 Hz
+        rows = np.ones((20, 2), np.float32)
+        t_ns = np.arange(20, dtype=np.int64) * STEP_NS
+        st = op.init_state(2, STEP_NS)
+        res, st = op.process(rows, t_ns, STEP_NS, st)
+        # pandas alignment: positions 0,2,4... valid from p >= w-1 = 4
+        assert list(res.score_t_ns) == [
+            int(p * STEP_NS) for p in range(4, 20, 2)
+        ]
+        assert np.allclose(res.scores, 1.0)
+
+    def test_nan_rows_are_inert(self):
+        rng = np.random.default_rng(2)
+        rows = (0.1 * rng.standard_normal((100, 3))).astype(np.float32)
+        rows[40:50] = np.nan
+        t_ns = np.arange(100, dtype=np.int64) * STEP_NS
+        for spec in OPS:
+            op = make_operator(spec)
+            st = op.init_state(3, STEP_NS)
+            res, st = op.process(rows, t_ns, STEP_NS, st)
+            for key, val in st.items():
+                arr = np.asarray(val)
+                if arr.dtype.kind == "f" and key != "ring":
+                    assert np.isfinite(arr).all(), (op.name, key)
+            assert all(np.isfinite(e["score"]) for e in res.events)
+
+
+# ---------------------------------------------------------------------------
+# durable artifacts
+
+
+class TestLedger:
+    EV = {"op": "stalta", "kind": "trigger", "channel": 1,
+          "t_ns": 10, "t_peak_ns": 11, "t_end_ns": 12, "score": 3.5,
+          "seq": 0}
+
+    def test_roundtrip_stamped(self, tmp_path):
+        evs = [dict(self.EV), {**self.EV, "seq": 1, "channel": 2}]
+        write_events(str(tmp_path), evs)
+        assert load_events(str(tmp_path)) == evs
+        raw = open(tmp_path / ".detect" / "events.jsonl").read()
+        assert '"_crc32"' in raw  # every line is stamped
+
+    def test_torn_line_falls_back_to_prev(self, tmp_path):
+        write_events(str(tmp_path), [dict(self.EV)])
+        write_events(str(tmp_path), [dict(self.EV),
+                                     {**self.EV, "seq": 1}])
+        path = tmp_path / ".detect" / "events.jsonl"
+        with open(path, "a") as fh:
+            fh.write('{"torn": tru')  # a half-written tail line
+        # ladder: primary torn -> .prev (one commit back)
+        assert load_events(str(tmp_path)) == [dict(self.EV)]
+
+    def test_write_event_lines_matches_write_events(self, tmp_path):
+        """The commit path caches serialized lines so a rewrite stamps
+        only NEW events — the cached-line file must be byte-identical
+        to the from-events serialization."""
+        from tpudas.detect.ledger import write_event_lines
+
+        evs = [dict(self.EV), {**self.EV, "seq": 1, "channel": 2}]
+        a, b = tmp_path / "a", tmp_path / "b"
+        write_events(str(a), evs)
+        write_event_lines(str(b), [event_line(e) for e in evs])
+        pa = a / ".detect" / "events.jsonl"
+        pb = b / ".detect" / "events.jsonl"
+        assert pa.read_bytes() == pb.read_bytes()
+        assert load_events(str(b)) == evs
+
+    def test_status_classification(self):
+        good = event_line(self.EV)
+        assert ledger_status_text(good + "\n")[0] == "ok"
+        assert ledger_status_text("")[0] == "ok"
+        unstamped = json.dumps(self.EV)
+        assert ledger_status_text(unstamped + "\n")[0] == "unstamped"
+        assert ledger_status_text("not json\n")[0] == "torn"
+        # tampered payload: stamp no longer matches
+        tampered = good.replace('"channel":1', '"channel":3')
+        assert ledger_status_text(tampered + "\n")[0] == "torn"
+        # seq gap
+        gap = event_line({**self.EV, "seq": 5})
+        assert ledger_status_text(gap + "\n")[0] == "torn"
+
+
+class TestScoreStore:
+    def _mk(self, tmp_path, tile_len=4, n_ch=2):
+        return ScoreStore.create(
+            str(tmp_path), epoch_ns=1000, n_ch=n_ch, tile_len=tile_len
+        )
+
+    def test_append_read_across_tiles(self, tmp_path):
+        store = self._mk(tmp_path)
+        t = np.arange(10, dtype=np.int64) * 2_000 + 1000
+        v = np.arange(20, dtype=np.float64).reshape(10, 2)
+        store.append(t[:3], v[:3])
+        store.append(t[3:], v[3:])
+        assert store.n_rows == 10
+        # 2 full tiles + 2 tail rows on disk
+        names = sorted(os.listdir(ScoreStore.scores_dir(str(tmp_path))))
+        assert "00000000.npy" in names and "00000001.npy" in names
+        re_t, re_v = ScoreStore.open(str(tmp_path)).read()
+        assert np.array_equal(re_t, t)
+        assert np.array_equal(re_v, v)
+        # windowed read
+        re_t, re_v = ScoreStore.open(str(tmp_path)).read(t[4], t[8])
+        assert np.array_equal(re_t, t[4:8])
+
+    def test_truncate_into_completed_tile(self, tmp_path):
+        store = self._mk(tmp_path)
+        t = np.arange(10, dtype=np.int64) * 2_000 + 1000
+        v = np.ones((10, 2))
+        store.append(t, v)
+        store.truncate_to(6)  # into tile 1
+        assert store.n_rows == 6
+        re = ScoreStore.open(str(tmp_path))
+        re_t, _ = re.read()
+        assert np.array_equal(re_t, t[:6])
+        with pytest.raises(Exception):
+            store.truncate_to(99)  # ahead of the store: unreconcilable
+
+    def test_crash_before_manifest_recovers_from_head_tile(
+        self, tmp_path
+    ):
+        """The real crash window: tiles and tails landed, the manifest
+        rename did not (append order is tiles -> tails -> manifest).
+        The stale manifest's partial region is recovered from the
+        completed-but-uncommitted head tile FILE, not from the
+        re-based tails (the pyramid's partial-read trick)."""
+        store = self._mk(tmp_path)
+        t = np.arange(10, dtype=np.int64) * 2_000 + 1000
+        v = np.arange(20, dtype=np.float64).reshape(10, 2)
+        store.append(t[:3], v[:3])
+        manifest_before = open(store.manifest_path).read()
+        # this append completes tile 0 AND leaves 3 re-based tail rows
+        # (>= the stale manifest's 3), the ambiguous case
+        store.append(t[3:], v[3:])
+        with open(store.manifest_path, "w") as fh:
+            fh.write(manifest_before)  # the crash: manifest is stale
+        re = ScoreStore.open(str(tmp_path))
+        assert re.n_rows == 3
+        re_t, re_v = re.read()
+        assert np.array_equal(re_t, t[:3])
+        assert np.array_equal(re_v, v[:3])
+
+
+# ---------------------------------------------------------------------------
+# driver integration
+
+
+class TestDriverIntegration:
+    def test_artifacts_events_metrics_health(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPUDAS_HEALTH", "1")
+        src, out = str(tmp_path / "src"), str(tmp_path / "out")
+        _spool(src, n_files=3)
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            rounds = _drive(src, out)
+        assert rounds >= 1
+        evs = load_events(out)
+        assert evs, "the tuned thresholds must produce events"
+        assert [e["seq"] for e in evs] == list(range(len(evs)))
+        assert {e["op"] for e in evs} <= {"stalta", "rms"}
+        # ledger order: close time, then operator, then channel
+        keys = [(e["t_end_ns"],) for e in evs]
+        assert keys == sorted(keys)
+        store = ScoreStore.open(out)
+        assert store is not None and store.n_rows > 0
+        t, v = store.read()
+        assert v.shape == (store.n_rows, NCH)
+        assert reg.value("tpudas_detect_rounds_total") >= 1
+        assert reg.value("tpudas_detect_rows_total") > 0
+        assert reg.value("tpudas_detect_ledger_events") == len(evs)
+        assert reg.value("tpudas_detect_errors_total") == 0
+        # the multi-subscriber emit hook served pyramid AND detect
+        from tpudas.serve.tiles import TileStore
+
+        assert TileStore.open(out) is not None
+        from tpudas.obs.health import read_health
+
+        health = read_health(out)
+        assert health["detect"]["ledger_events"] == len(evs)
+        assert health["detect"]["operators"] == ["stalta", "rms"]
+        # a second run over the same folder resumes, no reset
+        reg2 = MetricsRegistry()
+        with use_registry(reg2):
+            _drive(src, out)
+        assert reg2.value("tpudas_detect_carry_resumes_total") == 1
+        assert reg2.value("tpudas_detect_resets_total") == 0
+
+    def test_detect_off_leaves_no_artifacts(self, tmp_path):
+        src, out = str(tmp_path / "src"), str(tmp_path / "out")
+        _spool(src)
+        _drive(src, out, detect=False)
+        assert not os.path.isdir(os.path.join(out, ".detect"))
+
+    def test_enabling_later_catches_up_from_files(self, tmp_path):
+        """Detect switched on over a folder with prior outputs:
+        the file-backed catch-up recomputes the FULL history, equal to
+        an always-on control."""
+        src, out = str(tmp_path / "src"), str(tmp_path / "out")
+        src2, out2 = str(tmp_path / "src2"), str(tmp_path / "out2")
+        _spool(src)
+        _spool(src2)
+        _drive(src, out, detect=False, feed_third=True)
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            _drive(src, out, feed_third=True)  # detect on, no new data?
+        # control: detect on from the start
+        _drive(src2, out2, feed_third=True)
+        assert _detect_sig(out) == _detect_sig(out2)
+        assert reg.value("tpudas_detect_catchup_rows_total") > 0
+
+    def test_operator_config_change_resets_and_recomputes(
+        self, tmp_path
+    ):
+        src, out = str(tmp_path / "src"), str(tmp_path / "out")
+        _spool(src, n_files=3)
+        _drive(src, out)
+        sig = _detect_sig(out)
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            _drive(src, out, detect_operators=[OPS[0]])  # drop rms
+        assert reg.value("tpudas_detect_resets_total") == 1
+        assert load_events(out)  # recomputed under the new config
+        assert all(e["op"] == "stalta" for e in load_events(out))
+        # switching back recomputes the original state exactly
+        _drive(src, out)
+        assert _detect_sig(out) == sig
+
+    def test_grid_step_change_resets(self, tmp_path):
+        """The output grid step is operator geometry (recurrence
+        alphas, window row counts): a restart with a different step
+        must reset and recompute, not silently adopt the stale
+        step."""
+        src, out = str(tmp_path / "src"), str(tmp_path / "out")
+        _spool(src, n_files=3)
+        _drive(src, out)
+        reg0 = MetricsRegistry()
+        with use_registry(reg0):
+            DetectPipeline.open(out, operators=OPS, step_sec=1.0)
+        assert reg0.value("tpudas_detect_carry_resumes_total") == 1
+        assert reg0.value("tpudas_detect_resets_total") == 0
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            DetectPipeline.open(out, operators=OPS, step_sec=2.0)
+        assert reg.value("tpudas_detect_resets_total") == 1
+
+    def test_channel_count_change_resets(self, tmp_path):
+        """A restart with different channel geometry must reset and
+        recompute deterministically — not fail every round forever on
+        a stale carry whose per-channel states can never consume the
+        new rows."""
+        from tpudas.detect.runner import run_detect_round
+
+        src, out = str(tmp_path / "src"), str(tmp_path / "out")
+        _spool(src, n_files=3)
+        _drive(src, out)
+        sig = _detect_sig(out)
+        upto = int(load_detect_carry(out)["meta"]["upto_ns"])
+        alien = synthetic_patch(
+            t0=np.datetime64(upto + STEP_NS, "ns"), duration=10.0,
+            fs=1.0, n_ch=NCH + 2, seed=7, noise=0.01,
+        )
+        state = {}
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            run_detect_round(out, 1, [alien], state, operators=OPS,
+                             step_sec=1.0)
+        assert reg.value("tpudas_detect_resets_total") == 1
+        assert reg.value("tpudas_detect_errors_total") == 0
+        assert state["summary"]["ok"] is True
+        # the reset recomputed the whole history from the files
+        assert _detect_sig(out) == sig
+
+    def test_rolling_driver_parity(self, tmp_path):
+        """Satellite: run_rolling_realtime has the same emit capture +
+        pyramid/detect path."""
+        from tpudas.core.units import s as sec
+
+        src, out = str(tmp_path / "src"), str(tmp_path / "out")
+        _spool(src)
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            rounds = run_rolling_realtime(
+                source=src, output_folder=out, window=1.0 * sec,
+                step=1.0 * sec, poll_interval=0.0,
+                sleep_fn=lambda _: None, fault_policy=FAST,
+                pyramid=True, detect=True,
+                detect_operators=[
+                    ("rms", {"window": 5.0, "step": 2.0,
+                             "thresh": 1.5, "baseline": 10.0})],
+            )
+        assert rounds >= 1
+        assert reg.value("tpudas_detect_rounds_total") >= 1
+        store = ScoreStore.open(out)
+        assert store is not None and store.n_rows > 0
+        from tpudas.serve.tiles import TileStore
+
+        assert TileStore.open(out) is not None
+
+
+# ---------------------------------------------------------------------------
+# crash equivalence (the acceptance bar)
+
+
+class TestCrashResumeEquivalence:
+    """Kill the driver at each detect-relevant site mid-run, resume,
+    and the events ledger / operator carries / score tiles are
+    byte-identical to an uninterrupted control — the extension of
+    test_resilience.TestCrashResumeEquivalence to the detect state."""
+
+    SPECS = {
+        "detect.op": FaultSpec("detect.op", at=1, exc=KeyboardInterrupt),
+        "detect.ledger_write": FaultSpec(
+            "detect.ledger_write", at=1, exc=KeyboardInterrupt
+        ),
+        "carry.save": FaultSpec("carry.save", at=2,
+                                exc=KeyboardInterrupt),
+        "round.body": FaultSpec("round.body", at=2,
+                                exc=KeyboardInterrupt),
+        "fs.write_enospc": FaultSpec(
+            "fs.write_enospc", at=4, exc=KeyboardInterrupt
+        ),
+    }
+
+    @pytest.fixture(scope="class")
+    def control(self, tmp_path_factory):
+        td = tmp_path_factory.mktemp("detect_ctrl")
+        src, out = str(td / "src"), str(td / "out")
+        _spool(src)
+        rounds = _drive(src, out, feed_third=True)
+        assert rounds == 2
+        assert load_events(out), "control must have events"
+        return _detect_sig(out)
+
+    @pytest.mark.parametrize("site", sorted(SPECS))
+    def test_kill_resume_identical(self, tmp_path, control, site):
+        src, out = str(tmp_path / "src"), str(tmp_path / "out")
+        _spool(src)
+        plan = FaultPlan(self.SPECS[site])
+        with install_fault_plan(plan):
+            with pytest.raises(KeyboardInterrupt):
+                _drive(src, out, feed_third=True)
+        assert plan.fired, f"fault at {site} never fired"
+        rounds = _drive(src, out, feed_third=True)
+        assert rounds >= 1
+        assert _detect_sig(out) == control, (
+            f"detect state diverged after {site} kill"
+        )
+
+    def test_operator_failure_skipped_then_converges(self, tmp_path,
+                                                     control):
+        """An operator that raises is counted and skipped — the stream
+        survives, and the NEXT round's catch-up replays the rows so
+        the final state still matches the control."""
+        src, out = str(tmp_path / "src"), str(tmp_path / "out")
+        _spool(src)
+        plan = FaultPlan(FaultSpec("detect.op", at=1, exc=RuntimeError))
+        reg = MetricsRegistry()
+        with use_registry(reg), install_fault_plan(plan):
+            rounds = _drive(src, out, feed_third=True)
+        assert rounds == 2  # the stream never noticed
+        assert plan.fired
+        assert reg.value("tpudas_detect_errors_total") == 1
+        assert reg.value(
+            "tpudas_detect_op_errors_total", op="stalta"
+        ) == 1
+        assert _detect_sig(out) == control
+
+    def test_full_reset_recomputes_identically(self, tmp_path, control):
+        import shutil
+
+        src, out = str(tmp_path / "src"), str(tmp_path / "out")
+        _spool(src)
+        _drive(src, out, feed_third=True)
+        shutil.rmtree(os.path.join(out, ".detect"))
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            _drive(src, out, feed_third=True)
+        assert _detect_sig(out) == control
+
+
+# ---------------------------------------------------------------------------
+# audit (fsck) classification + repair
+
+
+class TestDetectAudit:
+    @pytest.fixture()
+    def folder(self, tmp_path):
+        # 3 files: a single round over 2 files emits too few decimated
+        # rows for the operators to warm up (no events => vacuous test)
+        src, out = str(tmp_path / "src"), str(tmp_path / "out")
+        _spool(src, n_files=3)
+        _drive(src, out)
+        assert load_events(out)
+        return src, out
+
+    def test_clean_folder_audits_clean(self, folder):
+        _, out = folder
+        rep = audit(out, repair=True)
+        assert rep["clean"] and not rep["issues"]
+
+    def test_surplus_ledger_truncated(self, folder):
+        _, out = folder
+        ledger = os.path.join(out, ".detect", "events.jsonl")
+        before = open(ledger).read()
+        evs = load_events(out)
+        fake = dict(evs[-1])
+        fake["seq"] = len(evs)
+        with open(ledger, "a") as fh:
+            fh.write(event_line(fake) + "\n")
+        rep = audit(out, repair=True)
+        assert any(i["action"] == "truncated" for i in rep["issues"])
+        assert open(ledger).read() == before
+        rep2 = audit(out, repair=True)
+        assert rep2["clean"] and not rep2["issues"]
+
+    def test_torn_ledger_no_prev_resets_then_recomputes(self, folder):
+        src, out = folder
+        sig = _detect_sig(out)
+        ledger = os.path.join(out, ".detect", "events.jsonl")
+        with open(ledger, "a") as fh:
+            fh.write('{"torn": tru')
+        for prev in (ledger + ".prev",):
+            if os.path.isfile(prev):
+                os.remove(prev)
+        rep = audit(out, repair=True)
+        assert any(
+            i["action"] == "reset_detect" for i in rep["issues"]
+        )
+        assert not os.path.isdir(os.path.join(out, ".detect"))
+        _drive(src, out)  # deterministic recompute from the outputs
+        assert _detect_sig(out) == sig
+
+    def test_zero_event_state_audits_clean(self, tmp_path):
+        """Quiet data: a committed carry + score tiles with NO
+        events.jsonl at all (a commit that has never seen an event
+        never writes one) is a healthy state — the startup audit must
+        not reset it on every restart."""
+        src, out = str(tmp_path / "src"), str(tmp_path / "out")
+        _spool(src, n_files=3)
+        quiet = [
+            ("stalta", {"sta": 2.0, "lta": 10.0, "on": 999.0,
+                        "off": 1.2}),
+            ("rms", {"window": 5.0, "step": 2.0, "thresh": 999.0,
+                     "baseline": 20.0}),
+        ]
+        _drive(src, out, detect_operators=quiet)
+        assert load_detect_carry(out) is not None
+        assert not os.path.isfile(
+            os.path.join(out, ".detect", "events.jsonl")
+        )
+        rep = audit(out, repair=True)
+        assert rep["clean"] and not rep["issues"]
+        reg = MetricsRegistry()
+        with use_registry(reg):  # restart: startup fsck + resume
+            _drive(src, out, detect_operators=quiet)
+        assert reg.value("tpudas_detect_resets_total") == 0
+        assert reg.value("tpudas_detect_carry_resumes_total") == 1
+
+    def test_torn_tails_resets_not_crashes(self, folder):
+        """Committed partial score rows whose tails.npy is torn (and
+        no completed head tile to recover from): ScoreStore.open
+        raises — the audit must classify and reset, never crash the
+        fsck."""
+        src, out = folder
+        sig = _detect_sig(out)
+        tails = os.path.join(out, ".detect", "scores", "tails.npy")
+        data = open(tails, "rb").read()
+        with open(tails, "wb") as fh:
+            fh.write(data[: len(data) // 2])
+        rep = audit(out, repair=True)  # must not raise
+        assert any(
+            i["action"] == "reset_detect" for i in rep["issues"]
+        )
+        rep2 = audit(out, repair=True)
+        assert rep2["clean"] and not rep2["issues"]
+        _drive(src, out)  # deterministic recompute from the outputs
+        assert _detect_sig(out) == sig
+
+    def test_unreadable_carry_resets(self, folder):
+        _, out = folder
+        carry = os.path.join(out, ".detect", "carry.npz")
+        with open(carry, "wb") as fh:
+            fh.write(b"not a zip")
+        for prev in (carry + ".prev", carry + ".prev.crc"):
+            if os.path.isfile(prev):
+                os.remove(prev)
+        rep = audit(out, repair=True)
+        assert any(
+            i["action"] == "reset_detect" for i in rep["issues"]
+        )
+        rep2 = audit(out, repair=True)
+        assert rep2["clean"] and not rep2["issues"]
+
+    def test_startup_fsck_runs_before_detect(self, folder, monkeypatch):
+        """The driver's own startup audit repairs a surplus ledger
+        before the pipeline loads it (no reconcile counter fires)."""
+        src, out = folder
+        evs = load_events(out)
+        fake = dict(evs[-1])
+        fake["seq"] = len(evs)
+        ledger = os.path.join(out, ".detect", "events.jsonl")
+        with open(ledger, "a") as fh:
+            fh.write(event_line(fake) + "\n")
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            _drive(src, out)
+        assert reg.value("tpudas_integrity_audit_repairs_total",
+                         kind="truncated") == 1
+        assert reg.value(
+            "tpudas_detect_reconcile_truncated_total"
+        ) == 0
+
+
+# ---------------------------------------------------------------------------
+# the /events query plane
+
+
+class TestEventsEndpoint:
+    @pytest.fixture(scope="class")
+    def server(self, tmp_path_factory):
+        from tpudas.serve.http import start_server
+
+        td = tmp_path_factory.mktemp("events_srv")
+        src, out = str(td / "src"), str(td / "out")
+        _spool(src, n_files=3)
+        _drive(src, out, health=True)
+        with start_server(out) as srv:
+            yield srv, out
+
+    def _get(self, srv, path):
+        with urllib.request.urlopen(srv.base_url + path) as resp:
+            return resp.status, json.loads(resp.read().decode()), resp
+
+    def test_all_events_verified(self, server):
+        srv, out = server
+        status, body, resp = self._get(srv, "/events")
+        assert status == 200
+        evs = load_events(out)
+        assert body["ledger_events"] == len(evs)
+        assert body["events"] == evs
+        assert resp.headers["X-Tpudas-Events-Total"] == str(len(evs))
+
+    def test_filters(self, server):
+        srv, out = server
+        _, body, _ = self._get(srv, "/events?min_score=2.2&op=stalta")
+        assert all(
+            e["score"] >= 2.2 and e["op"] == "stalta"
+            for e in body["events"]
+        )
+        _, body, _ = self._get(srv, "/events?c0=1&c1=2")
+        assert all(1 <= e["channel"] <= 2 for e in body["events"])
+        _, body, _ = self._get(srv, "/events?limit=2")
+        assert body["count"] <= 2
+        assert body["events"] == load_events(out)[-2:]  # newest kept
+        evs = load_events(out)
+        t_mid = evs[len(evs) // 2]["t_ns"]
+        _, body, _ = self._get(srv, f"/events?t0={t_mid}")
+        assert all(e["t_ns"] >= t_mid for e in body["events"])
+
+    def test_scores_window(self, server):
+        srv, out = server
+        _, body, _ = self._get(srv, "/events?scores=1&c0=1&c1=2")
+        sc = body["scores"]
+        assert sc["channel0"] == 1
+        store = ScoreStore.open(out)
+        assert len(sc["times_ns"]) == store.n_rows
+        assert len(sc["values"][0]) == 2  # channels 1..2
+
+    def test_bad_limit_is_400(self, server):
+        srv, _ = server
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._get(srv, "/events?limit=0")
+        assert ei.value.code == 400
+
+    def test_healthz_surfaces_detect(self, server):
+        srv, out = server
+        status, body, _ = self._get(srv, "/healthz")
+        assert status == 200
+        assert body["detect"]["ledger_events"] == len(load_events(out))
+        assert body["detect"]["ok"] is True
+
+    def test_scores_limit_caps_response(self, server):
+        srv, out = server
+        store = ScoreStore.open(out)
+        assert store.n_rows > 3
+        _, body, _ = self._get(srv, "/events?scores=1&scores_limit=3")
+        sc = body["scores"]
+        assert len(sc["times_ns"]) == 3
+        assert sc["truncated"] is True
+        assert sc["rows_total"] == store.n_rows
+        t, _v = store.read()
+        assert sc["times_ns"] == [int(x) for x in t[-3:]]  # newest
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._get(srv, "/events?scores=1&scores_limit=0")
+        assert ei.value.code == 400
+
+    def test_ledger_cache_invalidates_on_commit(self, tmp_path):
+        """/events serves from the stat-keyed parsed-ledger cache; a
+        new commit (atomic file replace) must invalidate it."""
+        from tpudas.serve.http import start_server
+
+        src, out = str(tmp_path / "src"), str(tmp_path / "out")
+        _spool(src, n_files=3)
+        _drive(src, out)
+        evs = load_events(out)
+        with start_server(out) as srv:
+            _, body, _ = self._get(srv, "/events?limit=100000")
+            assert body["ledger_events"] == len(evs)
+            _, body2, _ = self._get(srv, "/events?limit=100000")
+            assert body2["events"] == body["events"]  # cached hit
+            fake = dict(evs[-1])
+            fake["seq"] = len(evs)
+            write_events(out, evs + [fake])
+            _, body3, _ = self._get(srv, "/events?limit=100000")
+            assert body3["ledger_events"] == len(evs) + 1
+
+    def test_scores_degrade_on_torn_store(self, tmp_path):
+        """Committed partial rows with a torn tails.npy make
+        ScoreStore.open raise; ``/events?scores=1`` must degrade to
+        ``scores: null`` (200) — the events themselves were perfectly
+        readable, the response must not 500."""
+        from tpudas.serve.http import start_server
+
+        src, out = str(tmp_path / "src"), str(tmp_path / "out")
+        _spool(src, n_files=3)
+        _drive(src, out)
+        tails = os.path.join(out, ".detect", "scores", "tails.npy")
+        data = open(tails, "rb").read()
+        with open(tails, "wb") as fh:
+            fh.write(data[: len(data) // 2])
+        with start_server(out) as srv:
+            status, body, _ = self._get(srv, "/events?scores=1")
+        assert status == 200
+        assert body["scores"] is None
+        assert body["events"] == load_events(out)
+
+
+# ---------------------------------------------------------------------------
+# disk-pressure shedding
+
+
+class TestDetectShedding:
+    def test_shed_then_catchup(self, tmp_path):
+        """A disk-full episode that hits the detect writes: the first
+        failure notes pressure (swallowed), subsequent rounds SHED the
+        detect hook (counted), and once space returns the catch-up
+        replays everything — the state converges to an unshed
+        control."""
+        from tpudas.integrity import resource as _resource
+        from tpudas.testing import enospc_error
+
+        src, out = str(tmp_path / "src"), str(tmp_path / "out")
+        src2, out2 = str(tmp_path / "src2"), str(tmp_path / "out2")
+        _spool(src)
+        _spool(src2)
+        _drive(src2, out2, feed_third=True)  # control, no pressure
+        # ENOSPC on every detect-artifact write AND on the recovery
+        # probe: pressure flips in round 1 and STAYS (the probe keeps
+        # failing), so round 2 sheds the hook
+        plan = FaultPlan(
+            FaultSpec("fs.write_enospc", at=1, times=9999,
+                      exc=enospc_error(), match=".detect"),
+            FaultSpec("fs.write_enospc", at=1, times=9999,
+                      exc=enospc_error(), match=".space_probe"),
+        )
+        reg = MetricsRegistry()
+        try:
+            with use_registry(reg), install_fault_plan(plan):
+                rounds = _drive(src, out, feed_third=True)
+        finally:
+            _resource.clear_pressure("test done")
+        assert rounds == 2  # the stream itself never noticed
+        assert reg.value("tpudas_detect_errors_total") == 1
+        assert reg.value(
+            "tpudas_integrity_writes_shed_total", writer="detect"
+        ) >= 1
+        # space returns: the next run's catch-up replays everything
+        reg2 = MetricsRegistry()
+        with use_registry(reg2):
+            _drive(src, out, feed_third=True)
+        assert reg2.value("tpudas_detect_catchup_rows_total") > 0
+        assert _detect_sig(out) == _detect_sig(out2)
+
+
+class TestSummaryStatus:
+    def test_failure_and_shed_flip_ok(self, tmp_path):
+        """A failing or shed detect hook must flip the republished
+        health summary to ``ok: false`` (with ``last_error`` /
+        ``shed``) instead of leaving the last good round's numbers in
+        place forever."""
+        import shutil
+
+        from tpudas.detect.runner import (
+            mark_detect_shed,
+            run_detect_round,
+        )
+
+        src, out = str(tmp_path / "src"), str(tmp_path / "out")
+        _spool(src, n_files=3)
+        _drive(src, out)
+        shutil.rmtree(os.path.join(out, ".detect"))
+        state = {}
+        with install_fault_plan(FaultPlan(FaultSpec("detect.op", at=1))):
+            run_detect_round(out, 1, [], state, operators=OPS,
+                             step_sec=1.0)
+        assert state["pipe"] is None
+        assert state["summary"]["ok"] is False
+        assert state["summary"]["last_error"]
+        mark_detect_shed(state)
+        assert state["summary"]["shed"] is True
+        assert state["summary"]["ok"] is False
+        # the replayed round converges and flips the status back
+        run_detect_round(out, 2, [], state, operators=OPS,
+                         step_sec=1.0)
+        s = state["summary"]
+        assert s["ok"] is True and s["shed"] is False
+        assert s["last_error"] is None
+        assert s["ledger_events"] > 0
+
+
+class TestDefaultOff:
+    def test_env_gate(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("TPUDAS_DETECT", raising=False)
+        src, out = str(tmp_path / "src"), str(tmp_path / "out")
+        _spool(src, n_files=1)
+        _drive(src, out, detect=None, pyramid=False)
+        assert not os.path.isdir(os.path.join(out, ".detect"))

@@ -65,12 +65,31 @@ REQUIRED_METRICS = (
     "tpudas_integrity_resource_events_total",
     "tpudas_integrity_writes_shed_total",
     "tpudas_serve_pyramid_rebuilds_total",
+    # detect subsystem (PR 6): the /events query plane, the crash
+    # drill, and tools/detect_bench.py read these by name
+    "tpudas_detect_rounds_total",
+    "tpudas_detect_rows_total",
+    "tpudas_detect_events_total",
+    "tpudas_detect_op_seconds",
+    "tpudas_detect_op_errors_total",
+    "tpudas_detect_errors_total",
+    "tpudas_detect_ledger_events",
+    "tpudas_detect_ledger_appends_total",
+    "tpudas_detect_carry_saves_total",
+    "tpudas_detect_carry_resumes_total",
+    "tpudas_detect_catchup_rows_total",
+    "tpudas_detect_reconcile_truncated_total",
+    "tpudas_detect_resets_total",
+    "tpudas_serve_events_queries_total",
 )
 REQUIRED_SPANS = (
     "serve.request",
     "serve.query",
     "serve.pyramid_append",
     "integrity.audit",
+    "detect.round",
+    "detect.op",
+    "serve.events",
 )
 
 

@@ -16,8 +16,8 @@ One drill (per engine):
 2. for each of N cycles: feed one more interrogator file — but only
    when the PREVIOUS cycle ran to completion (epoch gating, below) —
    spawn the driver in a fresh subprocess (pyramid + health +
-   stateful carry on), SIGKILL it ``uniform(0.02, 0.95 * calib)``
-   seconds after it becomes ready;
+   stateful carry + detect operators on), SIGKILL it
+   ``uniform(0.02, 0.95 * calib)`` seconds after it becomes ready;
 3. run one final uninterrupted cycle to drain, then assert
    ``tpudas.integrity.audit`` reports **clean** (each worker already
    audited + repaired at startup — this run must find nothing left);
@@ -28,7 +28,12 @@ One drill (per engine):
      byte-identical — output *file boundaries* are round-schedule
      dependent, so files are compared by merged content, not name;
    - the tile pyramid is byte-identical file-by-file (tiles, tails,
-     manifest).
+     manifest);
+   - the detect state matches: the events ledger byte-identical, the
+     score tiles byte-identical file-by-file, and the operator
+     carries content-identical (meta + every state array — the
+     ``.npz`` container embeds zip timestamps, so the parsed content
+     is the comparable form).
 
 **Epoch gating.**  The carry only advances when a round completes, so
 every processing attempt spans exactly [end of last completed epoch →
@@ -73,6 +78,13 @@ N_CH = 4
 DT_OUT = 1.0
 EDGE_SEC = 5.0
 PATCH_OUT = 20
+# thresholds tuned so the drill's noisy synthetic stream actually
+# produces ledger events (an empty ledger would vacuously "match")
+DETECT_OPS = (
+    ("stalta", {"sta": 2.0, "lta": 10.0, "on": 2.0, "off": 1.2}),
+    ("rms", {"window": 5.0, "step": 2.0, "thresh": 1.5,
+             "baseline": 20.0}),
+)
 
 
 # ---------------------------------------------------------------------------
@@ -101,6 +113,8 @@ def _worker(src: str, out: str, engine: str) -> int:
         engine=engine,
         pyramid=True,
         health=True,
+        detect=True,
+        detect_operators=DETECT_OPS,
         max_rounds=8,
     )
     return 0
@@ -230,6 +244,55 @@ def _pyramid_tree(folder: str) -> dict:
     return out
 
 
+def _detect_state(folder: str) -> dict:
+    """The committed detect state, comparison-ready: the ledger's raw
+    bytes (deterministic canonical lines), a digest of every score
+    tile/tails file, and a digest of the PARSED carry (meta + array
+    bytes — the ``.npz`` container embeds zip timestamps, so raw
+    bytes cannot be compared across runs).  ``.prev`` rungs are
+    commit-schedule dependent and excluded, like the pyramid's."""
+    from tpudas.detect.ledger import DETECT_DIRNAME, ScoreStore
+    from tpudas.detect.runner import load_detect_carry
+    from tpudas.utils.atomicio import is_tmp_name
+
+    det = os.path.join(folder, DETECT_DIRNAME)
+    out: dict = {"present": os.path.isdir(det)}
+    if not out["present"]:
+        return out
+    ledger = os.path.join(det, "events.jsonl")
+    if os.path.isfile(ledger):
+        with open(ledger, "rb") as fh:
+            out["ledger_sha"] = hashlib.sha256(fh.read()).hexdigest()
+    carry = load_detect_carry(folder)
+    if carry is not None:
+        h = hashlib.sha256()
+        h.update(
+            json.dumps(carry["meta"], sort_keys=True).encode()
+        )
+        for st in carry["states"]:
+            for key in sorted(st):
+                import numpy as np
+
+                arr = np.asarray(st[key])
+                h.update(key.encode())
+                h.update(str(arr.dtype).encode())
+                h.update(arr.tobytes())
+        out["carry_sha"] = h.hexdigest()
+    scores = ScoreStore.scores_dir(folder)
+    tree = {}
+    if os.path.isdir(scores):
+        for name in sorted(os.listdir(scores)):
+            if ".prev" in name or is_tmp_name(name):
+                continue
+            path = os.path.join(scores, name)
+            if not os.path.isfile(path):
+                continue
+            with open(path, "rb") as fh:
+                tree[name] = hashlib.sha256(fh.read()).hexdigest()
+    out["scores"] = tree
+    return out
+
+
 def run_drill(
     engine: str = "cascade",
     cycles: int = 25,
@@ -293,6 +356,13 @@ def run_drill(
         outputs_match = _content_hash(out) == _content_hash(ctrl)
         pyr_out, pyr_ctrl = _pyramid_tree(out), _pyramid_tree(ctrl)
         pyramid_match = pyr_out == pyr_ctrl
+        det_out, det_ctrl = _detect_state(out), _detect_state(ctrl)
+        detect_match = det_out == det_ctrl
+        detect_events = 0
+        if det_out.get("ledger_sha"):
+            from tpudas.detect.ledger import load_events
+
+            detect_events = len(load_events(out))
         return {
             "engine": engine,
             "cycles": int(cycles),
@@ -306,10 +376,13 @@ def run_drill(
             "outputs_match": bool(outputs_match),
             "pyramid_match": bool(pyramid_match),
             "pyramid_files": len(pyr_out),
+            "detect_match": bool(detect_match),
+            "detect_events": int(detect_events),
             "cycle_log": cycle_log,
             "workdir": workdir,
             "ok": bool(
                 report["clean"] and outputs_match and pyramid_match
+                and detect_match
             ),
         }
     finally:
@@ -343,7 +416,9 @@ def main(argv=None) -> int:
             f"crash_drill: {engine}: kills={rep['kills']} "
             f"audit_clean={rep['audit_clean']} "
             f"outputs_match={rep['outputs_match']} "
-            f"pyramid_match={rep['pyramid_match']}"
+            f"pyramid_match={rep['pyramid_match']} "
+            f"detect_match={rep['detect_match']} "
+            f"(events={rep['detect_events']})"
         )
     payload = {"cycles": args.cycles, "seed": args.seed, "ok": ok,
                "engines": results}

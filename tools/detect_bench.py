@@ -1,0 +1,225 @@
+"""Detect-subsystem bench: per-round operator overhead + throughput.
+
+Measures what ISSUE 6 promises:
+
+1. **Per-round overhead** — realtime driver rounds with the detect
+   hook on (STA/LTA + rolling RMS, pyramid on, the production edge
+   configuration), jit warm: the fraction of the full round body
+   (``tpudas_stream_round_body_seconds``) spent inside the detect
+   hook (``tpudas_span_seconds{name="detect.round"}``).  Acceptance:
+   **< 2%** of a steady round.
+2. **Operator throughput** — decimated rows/second through each
+   operator's ``process`` (warm, steady 256-row blocks), plus the
+   end-to-end detect row rate observed in the driver run.
+
+The driver run feeds one interrogator file per round through the
+injected ``sleep_fn`` (the streaming tests' pattern), so every round
+after the first is a steady single-file round; a separate warm-up run
+in the same process compiles the jitted kernels first, keeping
+compile time out of the measured rounds.
+
+CLI:
+
+    JAX_PLATFORMS=cpu python tools/detect_bench.py [--out BENCH_pr06.json]
+        [--rounds 4] [--channels 256]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+T0 = "2023-03-22T00:00:00"
+# a production-shaped steady round: the reference's poll clamp is
+# >= 125 s, so one round ingests ~2 minutes of full-rate data from an
+# interrogator-scale array (1 kHz, 256 channels — the ROADMAP/
+# SNIPPETS scale direction) — measuring the detect hook against a toy
+# 20 s / 16-channel round would overstate the relative overhead ~100x
+# (the hook's cost is per DECIMATED row + a constant commit, the
+# round's cost is per full-rate sample)
+FS = 1000.0
+FILE_SEC = 120.0
+N_CH = 256
+DT_OUT = 1.0
+EDGE_SEC = 5.0
+PATCH_OUT = 60
+
+OPS = (
+    ("stalta", {"sta": 2.0, "lta": 10.0, "on": 3.0, "off": 1.5}),
+    ("rms", {"window": 5.0, "step": 2.0, "thresh": 3.0,
+             "baseline": 20.0}),
+)
+
+
+def _feed_file(src, index, n_ch):
+    import numpy as np
+
+    from tpudas.testing import make_synthetic_spool
+
+    make_synthetic_spool(
+        src, n_files=1, file_duration=FILE_SEC, fs=FS, n_ch=n_ch,
+        noise=0.01,
+        start=np.datetime64(T0)
+        + np.timedelta64(int(index * FILE_SEC * 1e9), "ns"),
+        prefix=f"raw{index:04d}",
+    )
+
+
+def _drive(src, out, n_ch, rounds, detect):
+    """One realtime run: a fresh file lands in ``src`` on every poll
+    sleep, so each processing round is a steady single-file round."""
+    from tpudas.proc.streaming import run_lowpass_realtime
+
+    fed = {"n": 2}
+
+    def sleep(_s):
+        if fed["n"] < rounds + 1:
+            _feed_file(src, fed["n"], n_ch)
+            fed["n"] += 1
+
+    return run_lowpass_realtime(
+        source=src, output_folder=out, start_time=T0,
+        output_sample_interval=DT_OUT, edge_buffer=EDGE_SEC,
+        process_patch_size=PATCH_OUT, poll_interval=0.0,
+        sleep_fn=sleep, pyramid=True, detect=detect,
+        detect_operators=list(OPS) if detect else None,
+    )
+
+
+def _hist(reg, metric, **labels):
+    m = reg.get(metric)
+    if m is None:
+        return {"count": 0, "sum": 0.0}
+    snap = m.snapshot(**labels)
+    return {"count": snap["count"], "sum": snap["sum"]}
+
+
+def bench_driver(n_ch=N_CH, rounds=4, workdir=None) -> dict:
+    from tpudas.obs.registry import MetricsRegistry, use_registry
+
+    workdir = workdir or tempfile.mkdtemp(prefix="detect_bench_")
+    # warm-up run: compiles the filter cascade AND the detect kernels
+    warm_src = os.path.join(workdir, "warm_src")
+    _feed_file(warm_src, 0, n_ch)
+    _feed_file(warm_src, 1, n_ch)
+    _drive(warm_src, os.path.join(workdir, "warm_out"), n_ch, 2, True)
+    # measured run, fresh registry
+    src = os.path.join(workdir, "src")
+    _feed_file(src, 0, n_ch)
+    _feed_file(src, 1, n_ch)
+    reg = MetricsRegistry()
+    with use_registry(reg):
+        n_rounds = _drive(
+            src, os.path.join(workdir, "out"), n_ch, rounds, True
+        )
+    body = _hist(reg, "tpudas_stream_round_body_seconds")
+    det = _hist(reg, "tpudas_span_seconds", name="detect.round")
+    rows = reg.value("tpudas_detect_rows_total")
+    events = reg.value("tpudas_detect_ledger_events")
+    body_mean = body["sum"] / max(body["count"], 1)
+    det_mean = det["sum"] / max(det["count"], 1)
+    overhead_pct = 100.0 * det["sum"] / body["sum"] if body["sum"] else 0.0
+    return {
+        "channels": n_ch,
+        "rounds": int(n_rounds),
+        "round_body_s_mean": round(body_mean, 5),
+        "detect_round_s_mean": round(det_mean, 5),
+        "detect_overhead_pct": round(overhead_pct, 3),
+        "driver_rows_total": int(rows),
+        "driver_rows_per_s": (
+            round(rows / det["sum"], 1) if det["sum"] else None
+        ),
+        "ledger_events": int(events),
+        "op_seconds": {
+            op: _hist(reg, "tpudas_detect_op_seconds", op=op)
+            for op in ("stalta", "rms")
+        },
+    }
+
+
+def bench_operators(n_ch=N_CH, n_rows=200_000, block=256) -> dict:
+    """Warm steady-block throughput of each operator in isolation."""
+    import numpy as np
+
+    from tpudas.detect.operators import make_operator
+
+    rng = np.random.default_rng(0)
+    step_ns = int(DT_OUT * 1e9)
+    out = {}
+    for spec in OPS:
+        op = make_operator(spec)
+        data = (0.1 * rng.standard_normal((n_rows, n_ch))).astype(
+            np.float32
+        )
+        t_ns = np.arange(n_rows, dtype=np.int64) * step_ns
+        state = op.init_state(n_ch, step_ns)
+        # warm: one block through (jit compile)
+        _res, state = op.process(
+            data[:block], t_ns[:block], step_ns, state
+        )
+        t0 = time.perf_counter()
+        fed = block
+        n_events = 0
+        while fed + block <= n_rows:
+            res, state = op.process(
+                data[fed:fed + block], t_ns[fed:fed + block], step_ns,
+                state,
+            )
+            n_events += len(res.events)
+            fed += block
+        wall = time.perf_counter() - t0
+        out[op.name] = {
+            "rows": int(fed - block),
+            "wall_s": round(wall, 4),
+            "rows_per_s": round((fed - block) / wall, 1),
+            "channel_samples_per_s": round(
+                (fed - block) * n_ch / wall, 1
+            ),
+            "events": int(n_events),
+        }
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default=None, help="write JSON report here")
+    ap.add_argument("--rounds", type=int, default=4)
+    ap.add_argument("--channels", type=int, default=N_CH)
+    ap.add_argument("--op-rows", type=int, default=200_000)
+    args = ap.parse_args(argv)
+    driver = bench_driver(n_ch=args.channels, rounds=args.rounds)
+    ops = bench_operators(n_ch=args.channels, n_rows=args.op_rows)
+    ok = driver["detect_overhead_pct"] < 2.0
+    payload = {
+        "bench": "detect (PR 6)",
+        "config": {
+            "fs_hz": FS, "file_sec": FILE_SEC, "dt_out_s": DT_OUT,
+            "operators": [list(o) for o in OPS],
+        },
+        "driver": driver,
+        "operators": ops,
+        "acceptance_overhead_lt_pct": 2.0,
+        "ok": bool(ok),
+    }
+    text = json.dumps(payload, indent=1, default=str)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(text + "\n")
+    print(text)
+    print(
+        f"detect_bench: overhead={driver['detect_overhead_pct']}% "
+        f"of a steady round ({'OK' if ok else 'FAILED'}, bar 2%)"
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

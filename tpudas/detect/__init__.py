@@ -1,0 +1,61 @@
+"""tpudas.detect — pluggable streaming detection over the live stream.
+
+The round loop (tpudas.proc.streaming) is open to registered
+:class:`~tpudas.detect.operators.StreamOperator` instances that
+consume the decimated output stream with the same O(1)-carry
+discipline the filters use: ``init_state`` / ``process(rows, t_ns,
+step_ns, carry) -> (results, carry)``, chunk-invariant by contract,
+so a retried round and a process restart replay byte-identically.
+
+- :mod:`tpudas.detect.operators` — the contract + registry and the
+  two first operators: jit-compiled recursive STA/LTA event detection
+  and per-channel rolling-RMS anomaly scoring;
+- :mod:`tpudas.detect.ledger` — the durable artifacts: a crc-stamped
+  append-only events ledger (JSONL + ``.prev``) and per-channel score
+  tiles, both classified/repaired by ``tpudas.integrity.audit`` and
+  shed as non-essential under disk pressure;
+- :mod:`tpudas.detect.runner` — the per-round hook the realtime
+  drivers call (``detect=True`` / ``TPUDAS_DETECT=1``): emitted-patch
+  fast path, file-backed catch-up, and the scores → ledger → carry
+  commit protocol.
+
+Query the results over HTTP via ``GET /events`` (tpudas.serve.http).
+See DETECTION.md for the operator contract, carry rules, ledger
+format, and the operator runbook.
+"""
+
+from tpudas.detect.ledger import (
+    DETECT_DIRNAME,
+    ScoreStore,
+    load_events,
+)
+from tpudas.detect.operators import (
+    DetectResult,
+    RollingRmsOperator,
+    StaLtaOperator,
+    StreamOperator,
+    make_operator,
+    operator_names,
+    register_operator,
+)
+from tpudas.detect.runner import (
+    DEFAULT_OPERATORS,
+    DetectPipeline,
+    run_detect_round,
+)
+
+__all__ = [
+    "DEFAULT_OPERATORS",
+    "DETECT_DIRNAME",
+    "DetectPipeline",
+    "DetectResult",
+    "RollingRmsOperator",
+    "ScoreStore",
+    "StaLtaOperator",
+    "StreamOperator",
+    "load_events",
+    "make_operator",
+    "operator_names",
+    "register_operator",
+    "run_detect_round",
+]

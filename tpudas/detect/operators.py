@@ -1,0 +1,484 @@
+"""Streaming detection operators: the pluggable-algorithm contract.
+
+FiLark (PAPERS.md) frames DAS software as a streaming-first platform
+with pluggable algorithm integration; this module is tpudas's version
+of that contract, built on the same O(1)-carry discipline the filter
+cascade uses (tpudas.proc.stream): a :class:`StreamOperator` consumes
+the DECIMATED output stream row by row and threads an explicit state
+dict ("carry") through every call, so a retried round and a process
+restart replay byte-identically.
+
+The contract (``init_state`` / ``process``) has two hard rules:
+
+1. **Chunk invariance.**  ``process`` may be called with the same
+   logical row stream split at ANY boundaries (the live path feeds a
+   round's emitted patches in power-of-two blocks; the catch-up path
+   re-reads the same rows from the output files in file-sized blocks).
+   Results — events, scores, and the final state — must be
+   bit-identical regardless of the split.  Practically: keep every
+   cross-row recurrence either strictly sequential (``lax.scan``, an
+   EMA) or windowed through a carried ring of the trailing rows.
+2. **State is the whole memory.**  Everything the operator needs to
+   resume lives in the state dict as numpy arrays (0-d arrays for
+   scalars) — the runner serializes it verbatim into the crc-stamped
+   detect carry (tpudas.detect.runner) and the SIGKILL crash drill
+   byte-compares it against an uninterrupted control.
+
+Two first operators ship:
+
+- ``"stalta"`` — recursive STA/LTA event detection (Earle & Shearer
+  style exponential averages, jit-compiled ``lax.scan``): per channel,
+  the short-term average of the squared signal over the long-term
+  average; a trigger opens at ``ratio >= on`` and closes at
+  ``ratio <= off`` (the LTA freezes while triggered so a long event
+  cannot raise its own floor).  Each CLOSED trigger becomes one ledger
+  event carrying onset/peak/end times and the peak ratio; an event
+  still open at a chunk boundary rides the carry.
+- ``"rms"`` — per-channel trailing rolling RMS (window ``window`` s,
+  emitted every ``step`` s on the global row grid, pandas alignment
+  via :func:`tpudas.ops.rolling.rolling_reduce`) plus anomaly scoring
+  against a slow EMA baseline: the RMS rows land in the score tile
+  store, and ``rms / baseline >= thresh`` (after the baseline warm-up)
+  emits an anomaly event per (position, channel).
+
+NaN rows (data gaps, rolling warm-up prefixes from the rolling-mean
+driver) are inert: recurrences freeze through them and they can never
+open a trigger or an anomaly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+__all__ = [
+    "DetectResult",
+    "StreamOperator",
+    "StaLtaOperator",
+    "RollingRmsOperator",
+    "make_operator",
+    "operator_names",
+    "register_operator",
+]
+
+
+@dataclass
+class DetectResult:
+    """What one ``process`` call produced.
+
+    ``events`` are ledger-ready dicts with the uniform schema
+    ``{op, kind, channel, t_ns, t_peak_ns, t_end_ns, score}``
+    (all times int ns, ``score`` a plain float).  ``scores`` /
+    ``score_t_ns`` are the per-channel score rows this chunk emitted
+    (``None``/empty when the operator has no score track)."""
+
+    events: list = field(default_factory=list)
+    scores: np.ndarray | None = None  # (S, C) float32
+    score_t_ns: np.ndarray | None = None  # (S,) int64
+
+
+class StreamOperator:
+    """Base contract for a registered streaming operator.
+
+    Subclasses define ``name`` (the registry key), ``params()`` (the
+    JSON-serializable configuration the carry validates on resume),
+    ``init_state(n_ch, step_ns)`` and
+    ``process(rows, t_ns, step_ns, state) -> (DetectResult, state)``.
+    ``rows`` is ``(T, C) float32`` time-major decimated output,
+    ``t_ns`` the ``(T,) int64`` row times, ``step_ns`` the output grid
+    step.  See the module docstring for the chunk-invariance rule.
+
+    ``has_score_track = True`` declares that ``process`` fills
+    ``DetectResult.scores``; the pipeline allows at most ONE such
+    operator per folder — the single-level score store holds one
+    time-monotone row track with no operator column, so interleaving
+    two operators' rows would corrupt its windowed reads.
+    """
+
+    name = "operator"
+    has_score_track = False
+
+    def params(self) -> dict:
+        raise NotImplementedError
+
+    def init_state(self, n_ch: int, step_ns: int) -> dict:
+        raise NotImplementedError
+
+    def process(self, rows, t_ns, step_ns, state):
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# the registry
+
+_REGISTRY: dict = {}
+
+
+def register_operator(cls):
+    """Class decorator: register ``cls`` under ``cls.name``."""
+    _REGISTRY[str(cls.name)] = cls
+    return cls
+
+
+def operator_names() -> tuple:
+    return tuple(sorted(_REGISTRY))
+
+
+def make_operator(spec) -> StreamOperator:
+    """Instantiate one operator from a spec: an instance (returned
+    as-is), a registered name, ``(name, params_dict)``, or
+    ``{"name": ..., **params}``."""
+    if isinstance(spec, StreamOperator):
+        return spec
+    if isinstance(spec, str):
+        name, params = spec, {}
+    elif isinstance(spec, dict):
+        params = dict(spec)
+        name = params.pop("name")
+    else:
+        name, params = spec
+        params = dict(params)
+    if name not in _REGISTRY:
+        raise ValueError(
+            f"unknown detect operator {name!r}; registered: "
+            f"{operator_names()}"
+        )
+    return _REGISTRY[name](**params)
+
+
+# ---------------------------------------------------------------------------
+# STA/LTA
+
+def _stalta_scan_impl(x2, sta0, lta0, in0, warm0, a_s, a_l, on, off,
+                      warm_rows):
+    """Sequential STA/LTA recurrence over one chunk.  Returns the new
+    (sta, lta, in_event, warm) state plus the per-row (ratio, trigger)
+    series.  NaN rows freeze both averages and force trigger False."""
+    import jax
+    import jax.numpy as jnp
+
+    def step(carry, xt):
+        sta, lta, in_ev, warm = carry
+        finite = jnp.isfinite(xt)
+        sta_n = jnp.where(finite, sta + a_s * (xt - sta), sta)
+        # classic freeze: the LTA holds while triggered, so an event
+        # cannot decay its own detection floor
+        lta_n = jnp.where(
+            finite & ~in_ev, lta + a_l * (xt - lta), lta
+        )
+        ratio = sta_n / jnp.maximum(lta_n, jnp.float32(1e-20))
+        ready = warm >= warm_rows
+        trig = jnp.where(in_ev, ratio > off, (ratio >= on) & ready)
+        trig = trig & finite
+        return (sta_n, lta_n, trig, warm + 1), (ratio, trig)
+
+    (sta, lta, in_ev, warm), (ratios, trigs) = jax.lax.scan(
+        step, (sta0, lta0, in0, warm0), x2
+    )
+    return sta, lta, in_ev, warm, ratios, trigs
+
+
+_stalta_scan = None  # jitted lazily (jax import stays off the cold path)
+
+
+def _get_stalta_scan():
+    global _stalta_scan
+    if _stalta_scan is None:
+        import jax
+
+        _stalta_scan = jax.jit(_stalta_scan_impl)
+    return _stalta_scan
+
+
+def _rms_base_scan_impl(rms_rows, base0, bwarm0, a_b, warm_min):
+    """Sequential EMA-baseline recurrence over the emitted RMS
+    positions.  Returns the final (base, bwarm) plus the per-position
+    anomaly ratio (0 while warming up or non-finite)."""
+    import jax
+    import jax.numpy as jnp
+
+    def step(carry, x):
+        base, bwarm = carry
+        finite = jnp.isfinite(x)
+        safe = finite & (base > 0) & (bwarm >= warm_min)
+        ratio = jnp.where(
+            safe, x / jnp.maximum(base, jnp.float32(1e-20)),
+            jnp.float32(0.0),
+        )
+        base_n = jnp.where(finite, base + a_b * (x - base), base)
+        return (base_n, bwarm + 1), ratio
+
+    (base, bwarm), ratios = jax.lax.scan(
+        step, (base0, bwarm0), rms_rows
+    )
+    return base, bwarm, ratios
+
+
+_rms_base_scan = None
+
+
+def _get_rms_base_scan():
+    global _rms_base_scan
+    if _rms_base_scan is None:
+        import jax
+
+        _rms_base_scan = jax.jit(_rms_base_scan_impl)
+    return _rms_base_scan
+
+
+@register_operator
+class StaLtaOperator(StreamOperator):
+    """Recursive STA/LTA trigger over the squared decimated stream.
+
+    ``sta`` / ``lta`` are the averaging time constants in seconds
+    (converted to per-row EMA coefficients from the output grid step);
+    ``on`` / ``off`` the trigger open/close ratio thresholds; triggers
+    are suppressed for the first ``lta`` seconds of rows (warm-up).
+    """
+
+    name = "stalta"
+
+    def __init__(self, sta=2.0, lta=20.0, on=3.0, off=1.5):
+        self.sta = float(sta)
+        self.lta = float(lta)
+        self.on = float(on)
+        self.off = float(off)
+        if self.sta <= 0 or self.lta <= self.sta:
+            raise ValueError(
+                f"need 0 < sta < lta, got sta={self.sta} lta={self.lta}"
+            )
+        if self.off > self.on:
+            raise ValueError(
+                f"off threshold {self.off} must not exceed on {self.on}"
+            )
+
+    def params(self) -> dict:
+        return {"sta": self.sta, "lta": self.lta, "on": self.on,
+                "off": self.off}
+
+    def init_state(self, n_ch: int, step_ns: int) -> dict:
+        return {
+            "sta": np.zeros(n_ch, np.float32),
+            "lta": np.zeros(n_ch, np.float32),
+            "in_event": np.zeros(n_ch, bool),
+            "warm": np.int32(0),
+            "peak": np.zeros(n_ch, np.float32),
+            "t_on": np.zeros(n_ch, np.int64),
+            "t_peak": np.zeros(n_ch, np.int64),
+        }
+
+    def _alphas(self, step_ns: int):
+        dt = step_ns / 1e9
+        a_s = np.float32(min(1.0, dt / self.sta))
+        a_l = np.float32(min(1.0, dt / self.lta))
+        warm_rows = np.int32(max(1, int(round(self.lta / dt))))
+        return a_s, a_l, warm_rows
+
+    def process(self, rows, t_ns, step_ns, state):
+        rows = np.asarray(rows, np.float32)
+        t_ns = np.asarray(t_ns, np.int64)
+        if rows.shape[0] == 0:
+            return DetectResult(), state
+        a_s, a_l, warm_rows = self._alphas(int(step_ns))
+        scan = _get_stalta_scan()
+        sta, lta, in_ev, warm, ratios, trigs = scan(
+            rows * rows,
+            state["sta"], state["lta"], state["in_event"],
+            np.int32(state["warm"]),
+            a_s, a_l, np.float32(self.on), np.float32(self.off),
+            warm_rows,
+        )
+        ratios = np.asarray(ratios)
+        trigs = np.asarray(trigs)
+        new_state = dict(state)
+        new_state["sta"] = np.asarray(sta)
+        new_state["lta"] = np.asarray(lta)
+        new_state["in_event"] = np.asarray(in_ev)
+        new_state["warm"] = np.int32(warm)
+        events = self._extract_events(t_ns, ratios, trigs, state, new_state)
+        return DetectResult(events=events), new_state
+
+    def _extract_events(self, t_ns, ratios, trigs, state, new_state):
+        """Close triggers into ledger events; open triggers ride the
+        carry (peak / t_on / t_peak per channel).  Walks only the
+        channels with any activity, so a quiet array costs one
+        ``any``."""
+        prev_in = np.asarray(state["in_event"], bool)
+        peak = np.array(state["peak"], np.float32, copy=True)
+        t_on = np.array(state["t_on"], np.int64, copy=True)
+        t_peak = np.array(state["t_peak"], np.int64, copy=True)
+        events = []
+        active = np.flatnonzero(prev_in | trigs.any(axis=0))
+        for c in active:
+            col = trigs[:, c]
+            r = ratios[:, c]
+            b = np.concatenate(
+                [[1 if prev_in[c] else 0], col.astype(np.int8)]
+            )
+            d = np.diff(b)
+            starts = list(np.flatnonzero(d == 1))
+            ends = list(np.flatnonzero(d == -1))
+            segs = []
+            if prev_in[c]:
+                segs.append((0, ends.pop(0) if ends else None, True))
+            while starts:
+                lo = starts.pop(0)
+                segs.append((lo, ends.pop(0) if ends else None, False))
+            for lo, hi, carried in segs:
+                hi_eff = len(col) if hi is None else hi
+                if carried:
+                    pk = float(peak[c])
+                    tpk = int(t_peak[c])
+                    ton = int(t_on[c])
+                else:
+                    pk, tpk, ton = float("-inf"), 0, int(t_ns[lo])
+                if hi_eff > lo:
+                    seg = r[lo:hi_eff]
+                    m = int(np.argmax(seg))
+                    if float(seg[m]) > pk:
+                        pk = float(seg[m])
+                        tpk = int(t_ns[lo + m])
+                if hi is None:
+                    # still open at the chunk end: persist in the carry
+                    peak[c] = np.float32(pk)
+                    t_peak[c] = tpk
+                    t_on[c] = ton
+                else:
+                    events.append(
+                        {
+                            "op": self.name,
+                            "kind": "trigger",
+                            "channel": int(c),
+                            "t_ns": ton,
+                            "t_peak_ns": tpk,
+                            "t_end_ns": int(t_ns[hi]),
+                            "score": pk,
+                        }
+                    )
+        # canonical carry: a channel with no OPEN event holds zeros —
+        # stale per-event scratch would otherwise depend on where the
+        # chunk boundaries fell and break carry byte-identity across
+        # restart schedules
+        closed = ~np.asarray(new_state["in_event"], bool)
+        peak[closed] = 0
+        t_on[closed] = 0
+        t_peak[closed] = 0
+        new_state["peak"] = peak
+        new_state["t_on"] = t_on
+        new_state["t_peak"] = t_peak
+        return events
+
+
+# ---------------------------------------------------------------------------
+# rolling RMS + anomaly score
+
+@register_operator
+class RollingRmsOperator(StreamOperator):
+    """Trailing rolling RMS per channel with EMA-baseline anomaly
+    scoring.
+
+    The RMS of the trailing ``window`` seconds is emitted every
+    ``step`` seconds on the GLOBAL row grid (positions ``p % s == 0``
+    with ``p >= w - 1``, pandas alignment — the same semantics as
+    :class:`tpudas.ops.rolling.PatchRoller`), independent of how the
+    stream was chunked: the carry holds the trailing ``w - 1`` raw
+    rows plus the global row index.  Each emitted RMS row updates a
+    slow EMA baseline (time constant ``baseline`` seconds); once the
+    baseline has seen a full time constant of positions,
+    ``rms / baseline >= thresh`` emits one anomaly event per
+    (position, channel)."""
+
+    name = "rms"
+    has_score_track = True
+
+    def __init__(self, window=10.0, step=5.0, thresh=4.0, baseline=60.0):
+        self.window = float(window)
+        self.step = float(step)
+        self.thresh = float(thresh)
+        self.baseline = float(baseline)
+        if self.window <= 0 or self.step <= 0:
+            raise ValueError("window and step must be positive seconds")
+        if self.baseline <= 0:
+            raise ValueError("baseline time constant must be positive")
+
+    def params(self) -> dict:
+        return {
+            "window": self.window,
+            "step": self.step,
+            "thresh": self.thresh,
+            "baseline": self.baseline,
+        }
+
+    def init_state(self, n_ch: int, step_ns: int) -> dict:
+        return {
+            "ring": np.zeros((0, n_ch), np.float32),
+            "row_idx": np.int64(0),
+            "base": np.zeros(n_ch, np.float32),
+            "bwarm": np.int32(0),
+        }
+
+    def _geometry(self, step_ns: int):
+        dt = step_ns / 1e9
+        w = max(1, int(round(self.window / dt)))
+        s = max(1, int(round(self.step / dt)))
+        return w, s, dt
+
+    def process(self, rows, t_ns, step_ns, state):
+        from tpudas.ops.rolling import rolling_reduce
+
+        rows = np.asarray(rows, np.float32)
+        t_ns = np.asarray(t_ns, np.int64)
+        if rows.shape[0] == 0:
+            return DetectResult(), state
+        w, s, dt = self._geometry(int(step_ns))
+        ring = np.asarray(state["ring"], np.float32)
+        row0 = int(state["row_idx"])
+        pool = np.concatenate([ring, rows]) if ring.size else rows
+        g0 = row0 - ring.shape[0]  # global index of pool[0]
+        # emitted global positions inside THIS chunk's row range
+        p_hi = row0 + rows.shape[0]
+        first = max(row0, w - 1)
+        first = ((first + s - 1) // s) * s
+        positions = np.arange(first, p_hi, s, dtype=np.int64)
+        new_state = dict(state)
+        keep = min(w - 1, pool.shape[0])
+        new_state["ring"] = np.ascontiguousarray(
+            pool[pool.shape[0] - keep:] if keep else pool[:0]
+        )
+        new_state["row_idx"] = np.int64(p_hi)
+        if positions.size == 0:
+            return DetectResult(), new_state
+        rr = np.asarray(rolling_reduce(pool * pool, w, 1, "mean"))
+        rms = np.sqrt(rr, dtype=rr.dtype).astype(np.float32)
+        rms_pos = rms[(positions - g0)]  # (S, C) emitted RMS rows
+        score_times = t_ns[(positions - row0)]
+        warm_min = max(1, int(round(self.baseline / (s * dt))))
+        a_b = np.float32(min(1.0, (s * dt) / self.baseline))
+        scan = _get_rms_base_scan()
+        base, bwarm, ratios = scan(
+            rms_pos, np.asarray(state["base"], np.float32),
+            np.int32(state["bwarm"]), a_b, np.int32(warm_min),
+        )
+        ratios = np.asarray(ratios)
+        events = []
+        for pi, c in np.argwhere(ratios >= np.float32(self.thresh)):
+            t_here = int(score_times[pi])
+            events.append(
+                {
+                    "op": self.name,
+                    "kind": "anomaly",
+                    "channel": int(c),
+                    "t_ns": t_here,
+                    "t_peak_ns": t_here,
+                    "t_end_ns": t_here,
+                    "score": float(ratios[pi, c]),
+                }
+            )
+        new_state["base"] = np.asarray(base)
+        new_state["bwarm"] = np.int32(bwarm)
+        return DetectResult(
+            events=events,
+            scores=rms_pos,
+            score_t_ns=np.asarray(score_times, np.int64),
+        ), new_state

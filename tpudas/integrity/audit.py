@@ -15,6 +15,12 @@ artifact            files
 ``index``           ``.tpudas_index.json`` (+ ``.prev``)
 ``pyramid``         ``.tiles/manifest.json`` (+ ``.prev``),
                     ``.tiles/tails.npy``, ``.tiles/L*/NNNNNNNN.npy``
+``detect_carry``    ``.detect/carry.npz`` (+ ``.crc``/``.prev``)
+``events``          ``.detect/events.jsonl`` (+ ``.prev``) — per-line
+                    crc32 stamps, contiguous ``seq``
+``scores``          ``.detect/scores/manifest.json`` (+ ``.prev``),
+                    ``.detect/scores/tails.npy``,
+                    ``.detect/scores/NNNNNNNN.npy``
 ``tmp``             any ``*.tmp`` / ``*.tmp.<pid>`` leftover anywhere in
                     the tree (a crashed writer's half file)
 ==================  =====================================================
@@ -34,7 +40,15 @@ fixes what it can, in artifact-appropriate ways:
   treats absence safely (carry → rewind, ledger → empty, health →
   regenerated next round, index → rescan);
 - a bad in-use pyramid artifact triggers a **rebuild** of ``.tiles/``
-  from the output files (byte-identical, the store is derived data).
+  from the output files (byte-identical, the store is derived data);
+- detect artifacts follow the same ladder with their own last rung: a
+  ledger/scores surplus beyond the detect carry is **truncated** back
+  to the carry's commit point (the runner's resume reconcile, made
+  durable), and anything unreconcilable — both ledger rungs bad, the
+  carry unreadable, committed score rows missing — **resets**
+  ``.detect/`` entirely: the detection history is derived data and
+  recomputes deterministically from the output files
+  (DETECTION.md, "Failure model").
 
 Run the CLI only while the driver is stopped (the tmp sweep cannot
 tell a crashed writer's leftovers from a live writer's in-flight
@@ -552,6 +566,293 @@ def _check_pyramid(
 
 
 # ---------------------------------------------------------------------------
+# detect artifacts (tpudas.detect: carry + events ledger + score tiles)
+
+
+
+def _detect_carry_status(path: str) -> tuple:
+    """(status, parsed_or_None, detail) for one detect-carry rung."""
+    from tpudas.detect.runner import _parse_detect_carry
+
+    if not os.path.isfile(path):
+        return "absent", None, ""
+    try:
+        crc = verify_file_checksum(path, artifact="detect_carry")
+    except FileNotFoundError:
+        return "absent", None, ""
+    try:
+        parsed = _parse_detect_carry(path)
+    except Exception as exc:
+        status = "torn" if crc == "mismatch" else "corrupt"
+        return status, None, f"{type(exc).__name__}: {str(exc)[:120]}"
+    if crc == "mismatch":
+        return "torn", None, "crc32 mismatch"
+    return ("unstamped" if crc == "unstamped" else "ok"), parsed, ""
+
+
+def _ledger_file_status(path: str) -> tuple:
+    """(status, events_or_None, detail) for one ledger rung: ok |
+    unstamped | torn | corrupt | absent."""
+    from tpudas.detect.ledger import ledger_status_text
+
+    if not os.path.isfile(path):
+        return "absent", None, ""
+    try:
+        with open(path) as fh:
+            text = fh.read()
+    except OSError as exc:
+        return "corrupt", None, f"{type(exc).__name__}: {str(exc)[:120]}"
+    status, events = ledger_status_text(text)
+    return ("torn" if status == "torn" else status), events, (
+        "bad line / crc mismatch / seq gap" if status == "torn" else ""
+    )
+
+
+def _reset_detect_state(folder, issues, repair, path, status, detail):
+    """The detect repair of last resort: remove ``.detect/`` — the
+    history recomputes deterministically from the output files."""
+    if repair:
+        from tpudas.detect.runner import reset_detect
+
+        reset_detect(folder, f"audit: {detail or status}")
+    _issue(
+        issues, "detect", path, status,
+        _repair_action(repair, "reset_detect"), detail,
+    )
+
+
+def _check_detect(folder: str, issues: list, repair: bool) -> None:
+    from tpudas.detect.ledger import (
+        DETECT_DIRNAME,
+        LEDGER_FILENAME,
+        ScoreStore,
+        write_events,
+    )
+    from tpudas.detect.runner import DETECT_CARRY_FILENAME
+
+    det = os.path.join(folder, DETECT_DIRNAME)
+    if not os.path.isdir(det):
+        return
+    if not os.listdir(det):
+        return  # an empty shell (partial creation) is not an issue
+    # --- the carry (the subsystem's single commit point) -------------
+    carry_path = os.path.join(det, DETECT_CARRY_FILENAME)
+    status, parsed, detail = _detect_carry_status(carry_path)
+    if status == "unstamped":
+        if repair:
+            write_sidecar_for(carry_path)
+        _issue(
+            issues, "detect_carry", carry_path, "unstamped",
+            _repair_action(repair, "restamped"),
+        )
+        status = "ok"
+    if status in ("torn", "corrupt", "absent"):
+        p_status, p_parsed, p_detail = _detect_carry_status(
+            carry_path + ".prev"
+        )
+        if p_status in ("ok", "unstamped"):
+            if repair:
+                _promote_prev(carry_path)
+                if p_status == "unstamped":
+                    write_sidecar_for(carry_path)
+            parsed = p_parsed
+            _issue(
+                issues, "detect_carry", carry_path,
+                "torn" if status == "absent" else status,
+                _repair_action(repair, "promoted_prev"),
+                detail or "orphaned .prev (primary missing)",
+            )
+        elif status == "absent" and p_status == "absent":
+            # artifacts without any carry cannot be trusted (which
+            # rows do they cover?)
+            _reset_detect_state(
+                folder, issues, repair, det, "corrupt",
+                "detect artifacts without a carry",
+            )
+            return
+        else:
+            _reset_detect_state(
+                folder, issues, repair, carry_path, status, detail
+            )
+            return
+    committed_seq = int(parsed["meta"]["ledger_seq"])
+    committed_rows = int(parsed["meta"]["score_rows"])
+    # --- the events ledger -------------------------------------------
+    ledger = os.path.join(det, LEDGER_FILENAME)
+    l_status, events, l_detail = _ledger_file_status(ledger)
+    if l_status in ("torn", "corrupt", "absent"):
+        p_status, p_events, _pd = _ledger_file_status(ledger + ".prev")
+        if p_status in ("ok", "unstamped"):
+            if repair:
+                _promote_prev(ledger)
+            events = p_events
+            _issue(
+                issues, "events", ledger,
+                "torn" if l_status == "absent" else l_status,
+                _repair_action(repair, "promoted_prev"), l_detail,
+            )
+            l_status = p_status
+        elif committed_seq == 0:
+            # zero committed events is a HEALTHY state with no ledger
+            # file at all (a commit that has never seen an event never
+            # writes one) — absence is not a defect, and a bad rung is
+            # repaired by truncating back to absence, never by
+            # resetting the carry and score tiles
+            if l_status != "absent":
+                if repair:
+                    _remove_all(ledger)
+                _issue(
+                    issues, "events", ledger, l_status,
+                    _repair_action(repair, "removed"), l_detail,
+                )
+            events = []
+            l_status = "ok"
+        else:
+            _reset_detect_state(
+                folder, issues, repair, ledger, l_status or "corrupt",
+                l_detail or "no loadable ledger rung",
+            )
+            return
+    if l_status == "unstamped":
+        if repair:
+            write_events(folder, events)
+        _issue(
+            issues, "events", ledger, "unstamped",
+            _repair_action(repair, "restamped"),
+        )
+    if len(events) < committed_seq:
+        _reset_detect_state(
+            folder, issues, repair, ledger, "corrupt",
+            f"ledger holds {len(events)} events, carry committed "
+            f"{committed_seq}",
+        )
+        return
+    if len(events) > committed_seq:
+        # a crashed commit's surplus — the runner's resume truncation,
+        # made durable (the lines regenerate identically on replay)
+        if repair:
+            write_events(folder, events[:committed_seq])
+        _issue(
+            issues, "events", ledger, "torn",
+            _repair_action(repair, "truncated"),
+            f"{len(events) - committed_seq} uncommitted events",
+        )
+    # a bad .prev behind a healthy primary is dead weight: sweep it
+    prev = ledger + ".prev"
+    if os.path.isfile(prev):
+        p_status, _pe, p_detail = _ledger_file_status(prev)
+        if p_status in ("torn", "corrupt"):
+            if repair:
+                _remove_all(prev)
+            _issue(
+                issues, "events", prev, p_status,
+                _repair_action(repair, "removed"), p_detail,
+            )
+    # --- the score tiles ---------------------------------------------
+    scores_dir = ScoreStore.scores_dir(folder)
+    if not os.path.isdir(scores_dir):
+        if committed_rows > 0:
+            _reset_detect_state(
+                folder, issues, repair, scores_dir, "corrupt",
+                f"carry committed {committed_rows} score rows but no "
+                "score store exists",
+            )
+        return
+    from tpudas.detect.ledger import (
+        SCORES_MANIFEST,
+        validate_scores_manifest,
+    )
+
+    manifest = os.path.join(scores_dir, SCORES_MANIFEST)
+    _check_json_artifact(
+        manifest, "scores_manifest", issues, repair,
+        validate=validate_scores_manifest,
+    )
+    try:
+        store = ScoreStore.open(folder)
+    except Exception as exc:
+        # e.g. CorruptDetectError: committed tail rows unrecoverable
+        # (torn tails with no completed head tile) — the audit must
+        # classify and reset, never crash the fsck
+        _reset_detect_state(
+            folder, issues, repair, scores_dir, "torn",
+            f"{type(exc).__name__}: {str(exc)[:120]}",
+        )
+        return
+    if store is None or store.n_rows < committed_rows:
+        _reset_detect_state(
+            folder, issues, repair, scores_dir, "corrupt",
+            "score store cannot supply the carry's committed rows",
+        )
+        return
+    # tiles + tails: restamp legacy, classify bad ones; an IN-USE bad
+    # artifact is unreconcilable (scores are not rebuildable without
+    # replaying rows) -> reset; an orphan beyond the manifest is swept
+    n_full = len(store.tile_t0_rel)
+    for name in sorted(os.listdir(scores_dir)):
+        m = _TILE_NAME_RE.match(name)
+        is_tails = name == "tails.npy"
+        if m is None and not is_tails:
+            continue
+        path = os.path.join(scores_dir, name)
+        try:
+            crc = verify_file_checksum(path, artifact="scores_tile")
+        except FileNotFoundError:
+            continue
+        ok_parse = True
+        if crc != "mismatch":
+            try:
+                import numpy as np
+
+                np.load(path)
+            except Exception:
+                ok_parse = False
+        if crc == "ok" and ok_parse:
+            continue
+        if crc == "unstamped" and ok_parse:
+            if repair:
+                write_sidecar_for(path)
+            _issue(
+                issues, "scores", path, "unstamped",
+                _repair_action(repair, "restamped"),
+            )
+            continue
+        bad_status = "torn" if crc == "mismatch" else "corrupt"
+        in_use = is_tails or int(m.group(1)) < n_full
+        if is_tails and (committed_rows % store.tile_len) == 0:
+            in_use = False  # no committed partial rows ride the tails
+        if in_use:
+            _reset_detect_state(
+                folder, issues, repair, path, bad_status,
+                "in-use score artifact failed verification",
+            )
+            return
+        if repair:
+            _remove_all(path, sidecar_path(path))
+        _issue(
+            issues, "scores", path, "orphan",
+            _repair_action(repair, "removed"),
+        )
+    if store.n_rows > committed_rows:
+        # a crashed commit's surplus rows: truncate back to the carry
+        surplus = store.n_rows - committed_rows
+        try:
+            if repair:
+                store.truncate_to(committed_rows)
+            _issue(
+                issues, "scores", scores_dir, "torn",
+                _repair_action(repair, "truncated"),
+                f"{surplus} uncommitted rows",
+            )
+        except Exception as exc:
+            _reset_detect_state(
+                folder, issues, repair, scores_dir, "corrupt",
+                f"truncate failed: {type(exc).__name__}: "
+                f"{str(exc)[:120]}",
+            )
+
+
+# ---------------------------------------------------------------------------
 
 _REPAIRED_ACTIONS = (
     "removed",
@@ -559,6 +860,8 @@ _REPAIRED_ACTIONS = (
     "restamped",
     "rewritten",
     "rebuilt_pyramid",
+    "reset_detect",
+    "truncated",
 )
 
 
@@ -592,6 +895,7 @@ def audit(folder, repair: bool = True, rebuild: bool = True) -> dict:
             )
             _check_outputs(folder, issues, repair)
             _check_pyramid(folder, issues, repair, rebuild)
+            _check_detect(folder, issues, repair)
     elapsed = time.perf_counter() - t0
     reg = get_registry()
     reg.counter(

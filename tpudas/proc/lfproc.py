@@ -282,13 +282,14 @@ class LFProc:
         # and propagates.
         self._pallas_ok = True
         self._pallas_proven = set()
-        # emission listener: called with every output patch AFTER its
-        # HDF5 write (the realtime driver feeds the serve-side tile
-        # pyramid from here, so the per-round append never re-reads
-        # the files it just watched being written).  Listener failures
-        # are counted and swallowed — a read-side consumer must not
-        # take down the write path.
-        self._on_emit = None
+        # emission listeners: each called with every output patch
+        # AFTER its HDF5 write (the realtime driver feeds the
+        # serve-side tile pyramid AND the detect operators from here,
+        # so neither per-round consumer re-reads the files it just
+        # watched being written, and registering one cannot clobber
+        # another).  Listener failures are counted and swallowed — a
+        # read-side consumer must not take down the write path.
+        self._emit_listeners: list = []
         # cross-check the first Pallas window of each shape against the
         # XLA formulation (off: TPUDAS_PALLAS_VERIFY=0) — a Mosaic
         # miscompile returning silently wrong numbers must not ship
@@ -421,6 +422,14 @@ class LFProc:
     def set_output_folder(self, folder, delete_existing=False):
         self._output_folder = folder
         self._setup_folder(folder, delete_existing)
+
+    def add_emit_listener(self, fn) -> None:
+        """Subscribe ``fn(result_patch)`` to every output emission
+        (called after the HDF5 write).  Multiple subscribers coexist —
+        the realtime driver registers one capture per consumer
+        (pyramid append, detect operators); failures are counted and
+        swallowed at the emit site."""
+        self._emit_listeners.append(fn)
 
     def get_last_processed_time(self):
         """Resume primitive: progress state lives entirely in the output
@@ -1405,9 +1414,9 @@ class LFProc:
         result.io.write(os.path.join(self._output_folder, filename), "dasdae")
         t_write = time.perf_counter() - t_w0
         self.timings["write_s"] += t_write
-        if self._on_emit is not None:
+        for listener in self._emit_listeners:
             try:
-                self._on_emit(result)
+                listener(result)
             except Exception as exc:
                 get_registry().counter(
                     "tpudas_emit_listener_errors_total",

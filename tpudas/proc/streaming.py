@@ -88,6 +88,10 @@ class _EdgeHealth:
         self.boundary = boundary  # FaultBoundary (degradation fields)
         self.carry_resumes = 0
         self.last_error = None
+        # optional detect summary (tpudas.detect) — surfaced in the
+        # snapshot (and through /healthz) as a "detect" sub-object;
+        # not part of the required schema, absent when detect is off
+        self.detect = None
         self._fb0 = fallback_count()  # run baseline for the delta
 
     def integrity_fallbacks(self) -> int:
@@ -108,9 +112,13 @@ class _EdgeHealth:
             or res_degraded
             or fallbacks > 0
         )
+        payload_extra = (
+            {} if self.detect is None else {"detect": self.detect}
+        )
         write_health(
             self.folder,
             {
+                **payload_extra,
                 "rounds": rounds,
                 "polls": polls,
                 "mode": mode,
@@ -172,9 +180,9 @@ def _append_pyramid(output_folder, rnd, emitted, state) -> None:
     into the :mod:`tpudas.serve.tiles` pyramid beside the carry.
 
     ``emitted`` holds the round's output patches captured in memory at
-    their write site (``LFProc._on_emit``), so the steady-state append
-    costs tile IO only — no index rescan, no re-reading files this
-    process just wrote.  ``state["store"]`` carries the open store
+    their write site (an ``LFProc.add_emit_listener`` subscription),
+    so the steady-state append costs tile IO only — no index rescan,
+    no re-reading files this process just wrote.  ``state["store"]`` carries the open store
     across rounds (a stat-gated refresh per round, not a re-parse);
     it is dropped to None on any failure — exactly the carry's
     crash-equivalent discipline — and any discontinuity (fresh
@@ -338,6 +346,8 @@ def run_lowpass_realtime(
     fault_policy=None,
     quarantine=True,
     pyramid=None,
+    detect=None,
+    detect_operators=None,
 ):
     """Poll ``source`` and keep the low-pass output current.
 
@@ -388,6 +398,18 @@ def run_lowpass_realtime(
     (manifest written after its tiles) and failures are counted and
     swallowed — the pyramid must never take down the stream that
     feeds it.
+
+    ``detect`` (default: ``TPUDAS_DETECT=1``) runs the registered
+    streaming detection operators (:mod:`tpudas.detect`) over each
+    round's decimated output — STA/LTA triggers and rolling-RMS
+    anomaly scores by default, or the ``detect_operators`` spec list
+    (names / ``(name, params)`` / instances).  Results land in the
+    crc-stamped events ledger and score tiles under
+    ``<output_folder>/.detect/`` (queryable via ``GET /events``); the
+    hook is crash-only like the pyramid (carry-committed, replayed via
+    file-backed catch-up after any failure) and an operator failure is
+    counted and skipped — it never takes down the stream.  See
+    DETECTION.md.
 
     ``fault_policy`` (a :class:`tpudas.resilience.RetryPolicy`; None =
     defaults) governs the per-round fault boundary: transient/corrupt
@@ -454,6 +476,9 @@ def run_lowpass_realtime(
     if pyramid is None:
         pyramid = os.environ.get("TPUDAS_PYRAMID", "0") == "1"
     pyramid = bool(pyramid)
+    if detect is None:
+        detect = os.environ.get("TPUDAS_DETECT", "0") == "1"
+    detect = bool(detect)
 
     if stateful is None:
         stateful = os.environ.get("TPUDAS_STREAM_STATEFUL", "1") != "0"
@@ -464,6 +489,7 @@ def run_lowpass_realtime(
     carry_checked = False  # disk/legacy resolution happens once
     rewind_wrote = False  # first rewind write invalidates any carry
     pyr_state = {"store": None}  # cross-round open tile store (pyramid)
+    det_state = {"pipe": None}  # cross-round detect pipeline (detect)
 
     processed_once = False  # first PROCESSING round always starts at
     # start_time, however many empty polls precede it (a pre-existing
@@ -524,10 +550,12 @@ def run_lowpass_realtime(
                         output_folder, delete_existing=False
                     )
                     emitted_patches = []
-                    if pyramid:
+                    if pyramid or detect:
                         # capture the round's output blocks at their
                         # write site for the in-memory pyramid append
-                        lfp._on_emit = emitted_patches.append
+                        # and the detect operators (multi-subscriber
+                        # emit hook — one shared capture serves both)
+                        lfp.add_emit_listener(emitted_patches.append)
                     if rolling_output_folder is not None:
                         lfp.set_rolling_output_folder(
                             rolling_output_folder, delete_existing=False
@@ -750,6 +778,21 @@ def run_lowpass_realtime(
                             output_folder, rnd, emitted_patches,
                             pyr_state,
                         )
+                    if detect:
+                        from tpudas.detect.runner import (
+                            mark_detect_shed,
+                            run_detect_round,
+                        )
+
+                        if _resource.should_shed("detect"):
+                            mark_detect_shed(det_state)
+                        else:
+                            run_detect_round(
+                                output_folder, rnd, emitted_patches,
+                                det_state, operators=detect_operators,
+                                step_sec=d_t,
+                            )
+                        edge_health.detect = det_state.get("summary")
                     boundary.on_success()
                     edge_health.write(
                         counters, rnd, polls, mode_str, round_rt, head_lag
@@ -787,6 +830,7 @@ def run_lowpass_realtime(
                     carry = None
                     carry_checked = False
                 pyr_state["store"] = None
+                det_state["pipe"] = None
                 edge_health.write(
                     counters, rounds, polls,
                     "stateful" if stateful else "rewind", 0.0, None,
@@ -847,6 +891,9 @@ def run_rolling_realtime(
     mesh=None,
     fault_policy=None,
     quarantine=True,
+    pyramid=None,
+    detect=None,
+    detect_operators=None,
 ):
     """Poll ``source`` and rolling-mean each NEW patch (stateless per
     file — rolling_mean_dascore_edge.ipynb:209-221). Returns rounds
@@ -864,8 +911,22 @@ def run_rolling_realtime(
     are retried with backoff, repeat-offender files quarantined.
     Patches written before a mid-round failure are in the ``processed``
     set already, so a retry resumes at the first unwritten patch.
+
+    Driver parity with :func:`run_lowpass_realtime`: each round's
+    output patches are captured in memory at their write site and fed
+    to the same per-round append hooks — ``pyramid`` (default
+    ``TPUDAS_PYRAMID=1``) keeps the :mod:`tpudas.serve.tiles` pyramid
+    current over the rolling output, and ``detect`` (default
+    ``TPUDAS_DETECT=1``, operators via ``detect_operators``) runs the
+    :mod:`tpudas.detect` streaming operators over it.  Both hooks are
+    crash-only, shed under disk pressure, and swallowed on failure.
+    Note the rolling grid is anchored per file: for a globally uniform
+    grid (what the pyramid and detect consumers assume) use a ``step``
+    that divides the file duration.
     """
     import os
+
+    from tpudas.core import units as _units
 
     if mesh is not None and "ch" not in mesh.shape:
         raise ValueError(
@@ -875,12 +936,23 @@ def run_rolling_realtime(
         )
     os.makedirs(output_folder, exist_ok=True)
     _startup_audit(output_folder)
+    from tpudas.integrity import resource as _resource
+
     interval = float(poll_interval) if poll_interval is not None else float(
         file_duration
     )
     policy = fault_policy if fault_policy is not None else RetryPolicy()
     ledger = QuarantineLedger(output_folder) if quarantine else None
     boundary = FaultBoundary(policy, ledger)
+    if pyramid is None:
+        pyramid = os.environ.get("TPUDAS_PYRAMID", "0") == "1"
+    pyramid = bool(pyramid)
+    if detect is None:
+        detect = os.environ.get("TPUDAS_DETECT", "0") == "1"
+    detect = bool(detect)
+    step_sec = _units.get_seconds(step)
+    pyr_state = {"store": None}  # cross-round open tile store (pyramid)
+    det_state = {"pipe": None}  # cross-round detect pipeline (detect)
     initial_run = True
     rounds = 0
     polls = 0
@@ -910,6 +982,7 @@ def run_rolling_realtime(
             if fresh:
                 rnd = rounds + 1
                 print("run number: ", rnd)
+                emitted_patches = []  # in-memory capture (pyramid/detect)
 
                 def write_out(j, out):
                     out = out.new(data=np.asarray(out.data) * scale)
@@ -920,6 +993,8 @@ def run_rolling_realtime(
                         os.path.join(output_folder, fname), "dasdae"
                     )
                     processed.add(keys[j])
+                    if pyramid or detect:
+                        emitted_patches.append(out)
 
                 # bounded chunks: memory stays O(chunk), outputs are
                 # written as soon as they are computed
@@ -958,10 +1033,35 @@ def run_rolling_realtime(
                                 )
                                 .mean(),
                             )
+                # driver parity with run_lowpass_realtime: the same
+                # per-round serve/detect append hooks over the same
+                # in-memory emit capture
+                if pyramid and not _resource.should_shed("pyramid"):
+                    _append_pyramid(
+                        output_folder, rnd, emitted_patches, pyr_state
+                    )
+                if detect:
+                    from tpudas.detect.runner import (
+                        mark_detect_shed,
+                        run_detect_round,
+                    )
+
+                    if _resource.should_shed("detect"):
+                        mark_detect_shed(det_state)
+                    else:
+                        run_detect_round(
+                            output_folder, rnd, emitted_patches,
+                            det_state, operators=detect_operators,
+                            step_sec=step_sec,
+                        )
                 rounds = rnd
             boundary.on_success()
+            if _resource.is_degraded():
+                _resource.probe_recovery(output_folder)
             initial_run = False
         except Exception as exc:
+            pyr_state["store"] = None
+            det_state["pipe"] = None
             decision = boundary.on_failure(exc)
             if decision.propagate:
                 raise

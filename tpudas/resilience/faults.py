@@ -54,7 +54,15 @@ block).  Production code marks its fault sites with
   (tpudas/utils/atomicio.py) plus the recovery probe
   (tpudas/integrity/resource.py): raise ``OSError(ENOSPC)`` here (see
   ``tpudas.testing.enospc_error``) and the process experiences a full
-  disk, degradation ladder included.
+  disk, degradation ladder included;
+- ``"detect.op"`` — the head of every detect-operator ``process``
+  call (tpudas/detect/runner.py): an injected fault here is counted,
+  the round's detect commit is skipped, and the rows replay via
+  catch-up next round — the stream itself never notices;
+- ``"detect.ledger_write"`` — the events-ledger rewrite
+  (tpudas/detect/ledger.py): kill here and the resumed pipeline
+  truncates the ledger back to the detect carry and regenerates the
+  lost lines byte-identically.
 """
 
 from __future__ import annotations
@@ -376,6 +384,8 @@ FAULT_SITES = (
     "serve.queue_full",
     "integrity.verify",
     "fs.write_enospc",
+    "detect.op",
+    "detect.ledger_write",
 )
 
 _ACTIONS = ("raise", "truncate", "delay")

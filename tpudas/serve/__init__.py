@@ -12,8 +12,10 @@ interactive latency without re-reading raw files:
   by an LRU tile cache with single-flight request coalescing and a
   full-resolution file fallback for windows older than the pyramid;
 - :mod:`tpudas.serve.http` — a zero-dependency threaded HTTP server
-  (``/query``, ``/waterfall``, ``/healthz``, ``/metrics``) with a
-  bounded admission gate that sheds load with 503 + Retry-After.
+  (``/query``, ``/waterfall``, ``/events``, ``/healthz``,
+  ``/metrics``) with a bounded admission gate that sheds load with
+  503 + Retry-After.  ``/events`` is the detection query plane over
+  the :mod:`tpudas.detect` events ledger and score tiles.
 
 See SERVING.md for the pyramid format, endpoint reference and the
 operator runbook.

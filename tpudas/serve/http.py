@@ -18,6 +18,12 @@ Endpoints (all GET):
   ``max_px`` (time-axis pixel budget, default 1024); picks the pyramid
   level from the budget and adds symmetric 95th-percentile color
   limits in ``X-Tpudas-Clim-*`` headers.
+- ``/events``    — the detection query plane (tpudas.detect): events
+  from the integrity-verified ledger filtered by time window
+  (``t0``/``t1``, optional), channel range (``c0``/``c1``),
+  ``min_score``, ``op``, ``kind``, capped at ``limit`` (default
+  1000); ``scores=1`` additionally returns the per-channel score rows
+  in the window from the score tile store.
 - ``/healthz``   — the stream's last good ``health.json`` snapshot
   (``tpudas.obs.health.read_health`` — the file stays the crash-safe
   source of truth; this is its live read path).
@@ -39,6 +45,7 @@ from __future__ import annotations
 
 import io
 import json
+import os
 import threading
 import time
 import urllib.parse
@@ -57,7 +64,59 @@ from tpudas.utils.logging import log_event
 __all__ = ["DASServer", "start_server", "serve_forever"]
 
 _DEFAULT_MAX_INFLIGHT = 8
-_DATA_ENDPOINTS = ("/query", "/waterfall")
+_DATA_ENDPOINTS = ("/query", "/waterfall", "/events")
+_DEFAULT_EVENTS_LIMIT = 1000
+_DEFAULT_SCORES_LIMIT = 10000
+
+
+def _load_events_cached(server):
+    """The parsed + crc-verified ledger, cached on the server keyed by
+    the primary file's ``(mtime_ns, size)`` — a dashboard polling
+    ``/events`` every second must not re-read and re-verify the whole
+    history per request (the tile cache's discipline; here a stat
+    suffices because every commit atomically replaces the file).
+    Absent-primary (``.prev``-fallback) reads are not cached."""
+    from tpudas.detect.ledger import ledger_path, load_events
+
+    try:
+        st = os.stat(ledger_path(server.folder))
+        key = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        key = None
+    if key is not None:
+        cached = getattr(server, "_events_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+    events = load_events(server.folder)
+    if key is not None:
+        server._events_cache = (key, events)
+    return events
+
+
+def _open_score_store_cached(server):
+    """``ScoreStore.open`` cached on the server keyed by the scores
+    manifest's ``(mtime_ns, size)`` — every commit (and truncation)
+    atomically rewrites the manifest, so a stat decides freshness the
+    same way :func:`_load_events_cached` does for the ledger.  Raises
+    propagate uncached (the caller owns the degrade path)."""
+    from tpudas.detect.ledger import SCORES_MANIFEST, ScoreStore
+
+    manifest = os.path.join(
+        ScoreStore.scores_dir(server.folder), SCORES_MANIFEST
+    )
+    try:
+        st = os.stat(manifest)
+        key = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        key = None
+    if key is not None:
+        cached = getattr(server, "_score_store_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+    store = ScoreStore.open(server.folder)
+    if key is not None:
+        server._score_store_cache = (key, store)
+    return store
 
 
 class _AdmissionGate:
@@ -212,6 +271,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._query(params, waterfall=False)
         if endpoint == "/waterfall":
             return self._query(params, waterfall=True)
+        if endpoint == "/events":
+            return self._events(params)
         self._send_json(404, {"error": f"unknown endpoint {endpoint!r}"})
         return 404
 
@@ -239,6 +300,120 @@ class _Handler(BaseHTTPRequestHandler):
         return 200
 
     # -- data plane ----------------------------------------------------
+    def _events(self, params: dict) -> int:
+        """The detection query plane: integrity-verified ledger events
+        (and optionally score rows) filtered by time/channel window,
+        score floor, operator and kind."""
+        t0_ns = (
+            int(np.datetime64(_parse_time(params["t0"]), "ns")
+                .astype(np.int64))
+            if "t0" in params else None
+        )
+        t1_ns = (
+            int(np.datetime64(_parse_time(params["t1"]), "ns")
+                .astype(np.int64))
+            if "t1" in params else None
+        )
+        c0 = int(params["c0"]) if "c0" in params else None
+        c1 = int(params["c1"]) if "c1" in params else None
+        min_score = (
+            float(params["min_score"]) if "min_score" in params else None
+        )
+        op = params.get("op")
+        kind = params.get("kind")
+        limit = int(params.get("limit", _DEFAULT_EVENTS_LIMIT))
+        if limit < 1:
+            raise ValueError(f"limit must be positive, got {limit}")
+        scores_limit = int(
+            params.get("scores_limit", _DEFAULT_SCORES_LIMIT)
+        )
+        if scores_limit < 1:
+            raise ValueError(
+                f"scores_limit must be positive, got {scores_limit}"
+            )
+        with span("serve.events"):
+            events = _load_events_cached(self.server)
+            total = len(events)
+            picked = []
+            # scan newest-first so the cap keeps the events happening
+            # NOW (the scores cap's discipline); chronological order
+            # is restored below
+            for ev in reversed(events):
+                if t0_ns is not None and int(ev.get("t_ns", 0)) < t0_ns:
+                    continue
+                if t1_ns is not None and int(ev.get("t_ns", 0)) >= t1_ns:
+                    continue
+                ch = int(ev.get("channel", -1))
+                if c0 is not None and ch < c0:
+                    continue
+                if c1 is not None and ch > c1:
+                    continue
+                if min_score is not None and float(
+                    ev.get("score", 0.0)
+                ) < min_score:
+                    continue
+                if op is not None and ev.get("op") != op:
+                    continue
+                if kind is not None and ev.get("kind") != kind:
+                    continue
+                picked.append(ev)
+                if len(picked) >= limit:
+                    break
+            picked.reverse()
+            payload = {
+                "events": picked,
+                "count": len(picked),
+                "ledger_events": total,
+            }
+            if params.get("scores") == "1":
+                try:
+                    store = _open_score_store_cached(self.server)
+                except Exception as exc:
+                    # an unreconcilable score store (the fsck's reset
+                    # case) must degrade the scores track, not fail a
+                    # response whose events were perfectly readable
+                    log_event(
+                        "serve_events_scores_unavailable",
+                        error=f"{type(exc).__name__}: {str(exc)[:200]}",
+                    )
+                    store = None
+                if store is None:
+                    payload["scores"] = None
+                else:
+                    s_t, s_v = store.read(t0_ns, t1_ns)
+                    rows_total = int(s_t.shape[0])
+                    if rows_total > scores_limit:
+                        # bound the response: keep the NEWEST rows in
+                        # the window (what a live dashboard wants)
+                        s_t = s_t[-scores_limit:]
+                        s_v = s_v[-scores_limit:]
+                    vals = s_v
+                    ch_lo = 0
+                    if c0 is not None or c1 is not None:
+                        ch_lo = max(0, c0 or 0)
+                        ch_hi = (
+                            min(vals.shape[1] - 1, c1)
+                            if c1 is not None else vals.shape[1] - 1
+                        )
+                        vals = vals[:, ch_lo:ch_hi + 1]
+                    payload["scores"] = {
+                        "times_ns": [int(t) for t in s_t],
+                        "channel0": int(ch_lo),
+                        "values": _json_safe(vals),
+                        "rows_total": rows_total,
+                        "truncated": rows_total > scores_limit,
+                    }
+        reg = get_registry()
+        reg.counter(
+            "tpudas_serve_events_queries_total",
+            "/events queries answered from the verified ledger",
+        ).inc()
+        self._send_json(
+            200, payload,
+            headers=(("X-Tpudas-Events-Total", total),),
+        )
+        return 200
+
     def _query(self, params: dict, waterfall: bool) -> int:
         if "t0" not in params or "t1" not in params:
             raise ValueError("t0 and t1 query parameters are required")
