@@ -6,6 +6,13 @@ xla_force_host_platform_device_count=8"). The hosting environment pins
 JAX_PLATFORMS to its TPU plugin and pre-imports jax from a
 sitecustomize, so setting env vars is not enough — we must also flip
 the platform via jax.config before any backend initialization.
+
+The ``cpu_mesh4`` fixture below is the CPU-mesh test rig (ISSUE 7):
+a session-scoped 4-device channel-sharding mesh over the virtualized
+host devices, so sharded == single-device byte-identity runs in
+tier-1 on any CPU box.  Tests that need a different layout call
+``tpudas.parallel.mesh.make_mesh`` themselves under the same 8
+virtual devices.
 """
 
 import os
@@ -19,5 +26,21 @@ if "xla_force_host_platform_device_count" not in _flags:
 os.environ.setdefault("MPLBACKEND", "Agg")
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh4():
+    """Session-scoped 4-device channel mesh (``{'time': 1, 'ch': 4}``)
+    over the CPU-virtualized devices — what the realtime sharded ==
+    single-device equivalence tests run on."""
+    if len(jax.devices()) < 4:
+        pytest.skip(
+            "needs >= 4 devices (XLA_FLAGS "
+            "--xla_force_host_platform_device_count)"
+        )
+    from tpudas.parallel.mesh import make_mesh
+
+    return make_mesh(4)

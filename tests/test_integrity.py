@@ -832,12 +832,28 @@ class TestCrashDrill:
         assert rep["pyramid_match"], rep
         assert rep["ok"]
 
-    @pytest.mark.slow
-    @pytest.mark.parametrize("engine", ["cascade", "fft"])
-    def test_full_drill(self, engine):
+    def test_smoke_mesh_drill_sharded_path(self):
+        """Tier-1 smoke of the --mesh drill (ISSUE 7): a seeded
+        SIGKILL cycle on the channel-sharded cascade ends audit-clean
+        and byte-identical to the SINGLE-DEVICE control replay — the
+        sharded path survives power cuts and stays bit-exact."""
         from tools.crash_drill import run_drill
 
-        rep = run_drill(engine=engine, cycles=25, seed=0)
+        rep = run_drill(engine="cascade", cycles=1, seed=5, mesh=4)
+        assert rep["mesh"] == 4
+        assert rep["audit_clean"], rep
+        assert rep["outputs_match"], rep
+        assert rep["pyramid_match"], rep
+        assert rep["detect_match"], rep
+        assert rep["ok"]
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("engine", ["cascade", "fft"])
+    @pytest.mark.parametrize("mesh", [0, 4])
+    def test_full_drill(self, engine, mesh):
+        from tools.crash_drill import run_drill
+
+        rep = run_drill(engine=engine, cycles=25, seed=0, mesh=mesh)
         assert rep["kills"] >= 15, rep  # most cycles really died
         assert rep["ok"], rep
 

@@ -81,6 +81,10 @@ REQUIRED_METRICS = (
     "tpudas_detect_reconcile_truncated_total",
     "tpudas_detect_resets_total",
     "tpudas_serve_events_queries_total",
+    # mesh-sharded streaming (PR 7): tools/stream_bench.py's scale
+    # sweep reads these by name to prove the device-resident carry
+    "tpudas_parallel_shards",
+    "tpudas_parallel_transfer_bytes_total",
 )
 REQUIRED_SPANS = (
     "serve.request",
@@ -90,6 +94,8 @@ REQUIRED_SPANS = (
     "detect.round",
     "detect.op",
     "serve.events",
+    "parallel.place",
+    "parallel.gather",
 )
 
 

@@ -49,7 +49,14 @@ chunking).
 CLI (the full acceptance drill — ``BENCH_pr05.json`` records a run):
 
     JAX_PLATFORMS=cpu python tools/crash_drill.py \
-        [--cycles 25] [--seed 0] [--engines cascade,fft] [--out PATH]
+        [--cycles 25] [--seed 0] [--engines cascade,fft] [--out PATH] \
+        [--mesh 4]
+
+``--mesh N`` (ISSUE 7) channel-shards every drilled cycle over N
+CPU-virtualized devices (``TPUDAS_MESH`` resolution in the driver)
+while the control replay stays single-device: one run then proves
+both that SIGKILL cycles on the SHARDED path end audit-clean and that
+the sharded path is byte-identical to the unsharded engines.
 
 ``tests/test_integrity.py`` runs a small seeded smoke in tier-1 and
 the full drill under ``-m slow``.
@@ -144,12 +151,26 @@ def _rm_ready(out: str) -> None:
         pass
 
 
-def _run_cycle(src, out, engine, kill_after, log_fh=None) -> dict:
+def _run_cycle(src, out, engine, kill_after, log_fh=None,
+               mesh=0) -> dict:
     """One worker subprocess; ``kill_after`` seconds after READY send
-    SIGKILL (None = let it finish).  Returns {killed, wall}."""
+    SIGKILL (None = let it finish).  ``mesh`` > 0 runs the worker
+    channel-sharded over that many CPU-virtualized devices
+    (``TPUDAS_MESH`` + ``--xla_force_host_platform_device_count``) —
+    the driver resolves the env var itself.  Returns {killed, wall}."""
     _rm_ready(out)
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    if mesh:
+        env["TPUDAS_MESH"] = str(int(mesh))
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={int(mesh)}"
+            ).strip()
+    else:
+        env.pop("TPUDAS_MESH", None)
     # share one persistent XLA cache across worker processes: after
     # the cold calibration cycle every worker warm-starts, so kills
     # land in real processing/write windows instead of jit compiles
@@ -301,14 +322,24 @@ def run_drill(
     files_init: int = 2,
     files_per_cycle: int = 1,
     log_path: str | None = None,
+    mesh: int = 0,
 ) -> dict:
     """One full drill for ``engine``; returns the report dict with
-    ``ok`` True when the audit is clean and both comparisons match."""
+    ``ok`` True when the audit is clean and both comparisons match.
+
+    ``mesh`` > 0 (ISSUE 7) runs every DRILLED cycle channel-sharded
+    over that many CPU-virtualized devices while the CONTROL replay
+    stays single-device — so one drill proves both that SIGKILL
+    cycles on the sharded path end audit-clean AND that the sharded
+    path is byte-identical to the unsharded cascade/fft."""
     import numpy as np
 
     from tpudas.integrity.audit import audit
 
-    workdir = workdir or tempfile.mkdtemp(prefix=f"crash_drill_{engine}_")
+    tag = f"crash_drill_{engine}_mesh{mesh}_" if mesh else (
+        f"crash_drill_{engine}_"
+    )
+    workdir = workdir or tempfile.mkdtemp(prefix=tag)
     src = os.path.join(workdir, "src")
     out = os.path.join(workdir, "out")
     ctrl = os.path.join(workdir, "ctrl")
@@ -318,11 +349,11 @@ def run_drill(
         epochs = [(0, files_init)]
         _feed(src, 0, files_init)
         # cold calibration: seeds the carry AND the shared XLA cache
-        cold = _run_cycle(src, out, engine, None, log_fh)
+        cold = _run_cycle(src, out, engine, None, log_fh, mesh=mesh)
         # warm calibration: the est the kill distribution draws from
         epochs.append((files_init, files_per_cycle))
         _feed(src, files_init, files_per_cycle)
-        warm = _run_cycle(src, out, engine, None, log_fh)
+        warm = _run_cycle(src, out, engine, None, log_fh, mesh=mesh)
         est = max(warm["wall"], 0.2)
         rng = np.random.default_rng(seed)
         n_files = files_init + files_per_cycle
@@ -335,7 +366,8 @@ def run_drill(
                 _feed(src, n_files, files_per_cycle)
                 n_files += files_per_cycle
             kill_after = float(rng.uniform(0.02, est * 0.95))
-            r = _run_cycle(src, out, engine, kill_after, log_fh)
+            r = _run_cycle(src, out, engine, kill_after, log_fh,
+                           mesh=mesh)
             kills += int(r["killed"])
             advance = not r["killed"]
             if not r["killed"]:
@@ -344,11 +376,13 @@ def run_drill(
                 est = max(0.5 * est + 0.5 * r["wall"], 0.2)
             cycle_log.append({"kill_after": round(kill_after, 3), **r})
         # drain: the resumed run finishes everything the kills left
-        _run_cycle(src, out, engine, None, log_fh)
+        _run_cycle(src, out, engine, None, log_fh, mesh=mesh)
         # the drained folder must audit clean (each worker already
         # audited at startup; this run may not find anything new)
         report = audit(out, repair=True)
-        # control: replay the SAME epoch schedule, uninterrupted
+        # control: replay the SAME epoch schedule, uninterrupted — and
+        # ALWAYS single-device, so a mesh drill also pins
+        # sharded == unsharded byte-identity end to end
         ctrl_src = os.path.join(workdir, "ctrl_src")
         for first, count in epochs:
             _feed(ctrl_src, first, count)
@@ -365,6 +399,7 @@ def run_drill(
             detect_events = len(load_events(out))
         return {
             "engine": engine,
+            "mesh": int(mesh),
             "cycles": int(cycles),
             "seed": int(seed),
             "kills": kills,
@@ -400,15 +435,20 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--out", default=None, help="write JSON report here")
     ap.add_argument("--log", default=None, help="worker stdout log file")
+    ap.add_argument(
+        "--mesh", type=int, default=0,
+        help="channel-shard the DRILLED cycles over N CPU-virtualized "
+        "devices (the control replay stays single-device)",
+    )
     args = ap.parse_args(argv)
     results = {}
     ok = True
     for engine in [e for e in args.engines.split(",") if e]:
         print(f"crash_drill: engine={engine} cycles={args.cycles} "
-              f"seed={args.seed}")
+              f"seed={args.seed} mesh={args.mesh}")
         rep = run_drill(
             engine=engine, cycles=args.cycles, seed=args.seed,
-            log_path=args.log,
+            log_path=args.log, mesh=args.mesh,
         )
         results[engine] = rep
         ok = ok and rep["ok"]
@@ -420,8 +460,8 @@ def main(argv=None) -> int:
             f"detect_match={rep['detect_match']} "
             f"(events={rep['detect_events']})"
         )
-    payload = {"cycles": args.cycles, "seed": args.seed, "ok": ok,
-               "engines": results}
+    payload = {"cycles": args.cycles, "seed": args.seed,
+               "mesh": args.mesh, "ok": ok, "engines": results}
     if args.out:
         with open(args.out, "w") as fh:
             json.dump(payload, fh, indent=1)

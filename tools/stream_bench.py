@@ -37,6 +37,24 @@ so CI can run it anywhere:
         [--rounds N] [--files-per-round K]
 
 Also reachable as ``BENCH_MODE=stream python bench.py``.
+
+Scale mode (ISSUE 7, ``BENCH_pr07.json``): ``--channels`` switches the
+bench to the interrogator-scale sweep — per-width single-device vs
+mesh-sharded realtime rounds over a 1 kHz synthetic spool, up to the
+10,000-channel target:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+        python tools/stream_bench.py --channels 512,2048,10000 --mesh 4 \
+        [--out BENCH_pr07.json]
+
+Per width it reports steady-round wall, realtime factor, head lag and
+a single-vs-sharded byte-identity check on the merged outputs; at the
+widest configuration it additionally measures the device-resident
+carry claim: host-transfer bytes per round
+(``tpudas_parallel_transfer_bytes_total``) under the every-round save
+cadence (the PR 6 behavior) vs ``TPUDAS_CARRY_SAVE_EVERY`` — steady
+non-save rounds must move ZERO carry bytes to host (the
+no-host-sync-per-round check).
 """
 
 from __future__ import annotations
@@ -502,16 +520,328 @@ def run(out_path, rounds=4, files_per_round=2):
     return report
 
 
+# ---------------------------------------------------------------------------
+# scale mode (ISSUE 7): interrogator-width single-vs-sharded sweep
+
+SCALE_FS = 1000.0  # the paper's kHz interrogator rate
+SCALE_FILE_SEC = 8.0
+SCALE_DT_OUT = 1.0  # 1000x decimation, the flagship config
+SCALE_EDGE_SEC = 16.0
+
+
+def _drive_scale(src, out, rounds, mesh, save_every=1,
+                 feed=None, on_round_extra=None):
+    """One scale-mode realtime run under a fresh registry.  Returns
+    (registry, per-round samples): each sample holds the round's wall
+    seconds, data seconds, and the cumulative host-transfer counters
+    read INSIDE on_round — the per-round deltas are the
+    no-host-sync-per-round evidence."""
+    from tpudas.obs.registry import MetricsRegistry, use_registry
+    from tpudas.proc.streaming import run_lowpass_realtime
+    from tpudas.utils.logging import set_log_handler
+    from tpudas.utils.profiling import Counters
+
+    events = []
+    set_log_handler(events.append)
+    counters = Counters()
+    state = {"fed": 0}
+
+    def fake_sleep(_):
+        if state["fed"] < rounds - 1:
+            state["fed"] += 1
+            feed(state["fed"])
+
+    reg = MetricsRegistry()
+    samples = []
+
+    def on_round(rnd, _lfp):
+        samples.append({
+            "round": rnd,
+            "gather_bytes": reg.value(
+                "tpudas_parallel_transfer_bytes_total",
+                direction="gather",
+            ),
+            "place_bytes": reg.value(
+                "tpudas_parallel_transfer_bytes_total",
+                direction="place",
+            ),
+            "carry_saves": reg.value("tpudas_stream_carry_saves_total"),
+        })
+        if on_round_extra is not None:
+            on_round_extra(rnd)
+
+    try:
+        with use_registry(reg):
+            run_lowpass_realtime(
+                source=src,
+                output_folder=out,
+                start_time="2023-03-22T00:00:00",
+                output_sample_interval=SCALE_DT_OUT,
+                edge_buffer=SCALE_EDGE_SEC,
+                process_patch_size=64,
+                poll_interval=0.0,
+                file_duration=0.0,
+                sleep_fn=fake_sleep,
+                max_rounds=rounds + 2,
+                counters=counters,
+                mesh=mesh,
+                carry_save_every=save_every,
+                on_round=on_round,
+                health=False,
+                pyramid=False,
+                detect=False,
+            )
+    finally:
+        set_log_handler(None)
+    per_round = [e for e in events if e["event"] == "realtime_round"]
+    for s, e in zip(samples, per_round):
+        s["wall_s"] = e["wall_seconds"]
+        s["data_s"] = e["data_seconds"]
+    return reg, samples
+
+
+def _scale_feeder(src, n_init, files_per_round, n_ch):
+    from tpudas.testing import make_synthetic_spool
+
+    def feed(r):
+        make_synthetic_spool(
+            src,
+            n_files=files_per_round,
+            file_duration=SCALE_FILE_SEC,
+            fs=SCALE_FS,
+            n_ch=n_ch,
+            noise=0.01,
+            format="tdas",
+            write_kwargs={"dtype": "int16", "scale": 1e-3},
+            start=np.datetime64("2023-03-22T00:00:00")
+            + np.timedelta64(
+                int(
+                    (n_init + (r - 1) * files_per_round)
+                    * SCALE_FILE_SEC * 1e9
+                ),
+                "ns",
+            ),
+            prefix=f"raw{r}",
+        )
+
+    return feed
+
+
+def run_scale(out_path, channels, mesh_n, rounds=4, save_every=4):
+    """The ISSUE 7 sweep: per-width single-device vs mesh-sharded
+    realtime throughput + head lag, byte-identity of the merged
+    outputs, and — at the widest configuration — per-round host
+    transfer under both carry-save cadences."""
+    import tempfile
+
+    from tpudas.testing import make_synthetic_spool
+
+    # the host-transfer section compares this cadence against the
+    # every-round baseline; 1 would collide the two measurement tags
+    save_every = max(2, int(save_every))
+    t_bench0 = time.perf_counter()
+    try:
+        n_cores = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        n_cores = os.cpu_count() or 1
+    widths = []
+    n_init = 2
+    for n_ch in channels:
+        with tempfile.TemporaryDirectory() as td:
+            per_mode = {}
+            # mesh=0 (not None) for the baseline: an explicit argument
+            # beats a TPUDAS_MESH in the caller's environment, so the
+            # "single" leg can never silently run sharded
+            for mode, mesh in (("single", 0), ("sharded", mesh_n)):
+                src = os.path.join(td, f"src_{mode}")
+                out = os.path.join(td, f"out_{mode}")
+                make_synthetic_spool(
+                    src, n_files=n_init, file_duration=SCALE_FILE_SEC,
+                    fs=SCALE_FS, n_ch=n_ch, noise=0.01, format="tdas",
+                    write_kwargs={"dtype": "int16", "scale": 1e-3},
+                )
+                t0 = time.perf_counter()
+                reg, samples = _drive_scale(
+                    src, out, rounds, mesh,
+                    save_every=save_every,
+                    feed=_scale_feeder(src, n_init, 1, n_ch),
+                )
+                total = time.perf_counter() - t0
+                steady = [s["wall_s"] for s in samples[1:]]
+                steady_wall = min(steady) if steady else None
+                data_s = samples[-1]["data_s"] if samples else 0.0
+                p = _merged(out)
+                t_in = SCALE_FILE_SEC * (n_init + rounds - 1)
+                t_out = (
+                    np.datetime64(p.coords["time"][-1], "ns")
+                    - np.datetime64("2023-03-22T00:00:00", "ns")
+                ) / np.timedelta64(1, "s")
+                per_mode[mode] = {
+                    "steady_round_wall_s": (
+                        None if steady_wall is None
+                        else round(steady_wall, 3)
+                    ),
+                    "round_data_seconds": round(data_s, 3),
+                    "realtime_factor": (
+                        None if not steady_wall
+                        else round(data_s / steady_wall, 2)
+                    ),
+                    "head_lag_s": round(float(t_in - t_out), 3),
+                    "total_wall_s": round(total, 2),
+                    "channel_samples_per_s": (
+                        None if not steady_wall
+                        else int(data_s * SCALE_FS * n_ch / steady_wall)
+                    ),
+                    "rounds": len(samples),
+                    "gather_bytes_total": samples[-1]["gather_bytes"]
+                    if samples else 0,
+                }
+                per_mode[mode]["_patch"] = p
+            a = per_mode["single"].pop("_patch")
+            b = per_mode["sharded"].pop("_patch")
+            identical = bool(
+                np.array_equal(a.host_data(), b.host_data())
+                and np.array_equal(a.coords["time"], b.coords["time"])
+            )
+            f_single = per_mode["single"]["realtime_factor"] or 0
+            f_shard = per_mode["sharded"]["realtime_factor"] or 0
+            widths.append({
+                "n_ch": n_ch,
+                **{m: per_mode[m] for m in per_mode},
+                "outputs_byte_identical": identical,
+                "sharded_speedup": (
+                    round(f_shard / f_single, 3) if f_single else None
+                ),
+            })
+            print(json.dumps(widths[-1]))
+
+    # device-resident carry: per-round host transfer at the widest
+    # width, every-round save cadence (the PR 6 behavior: the whole
+    # pytree serialized each round) vs the deferred cadence
+    n_ch = max(channels)
+    transfer = {}
+    with tempfile.TemporaryDirectory() as td:
+        for tag, every in (("save_every_1", 1), (f"save_every_{save_every}",
+                                                 save_every)):
+            src = os.path.join(td, f"src_{tag}")
+            out = os.path.join(td, f"out_{tag}")
+            make_synthetic_spool(
+                src, n_files=n_init, file_duration=SCALE_FILE_SEC,
+                fs=SCALE_FS, n_ch=n_ch, noise=0.01, format="tdas",
+                write_kwargs={"dtype": "int16", "scale": 1e-3},
+            )
+            reg, samples = _drive_scale(
+                src, out, rounds, mesh_n, save_every=every,
+                feed=_scale_feeder(src, n_init, 1, n_ch),
+            )
+            deltas = [
+                samples[i]["gather_bytes"] - samples[i - 1]["gather_bytes"]
+                for i in range(1, len(samples))
+            ]
+            saves = [
+                samples[i]["carry_saves"] - samples[i - 1]["carry_saves"]
+                for i in range(1, len(samples))
+            ]
+            transfer[tag] = {
+                "gather_bytes_per_round": deltas,
+                "carry_saves_per_round": saves,
+                "mean_gather_bytes_per_round": (
+                    int(sum(deltas) / len(deltas)) if deltas else 0
+                ),
+                "non_save_rounds_move_zero_bytes": all(
+                    d == 0 for d, s in zip(deltas, saves) if s == 0
+                ),
+            }
+    base = transfer["save_every_1"]["mean_gather_bytes_per_round"]
+    tail = transfer[f"save_every_{save_every}"][
+        "mean_gather_bytes_per_round"
+    ]
+    # deferred-cadence steady rounds gathering ZERO bytes reads as a
+    # reduction by the full baseline (max(tail, 1) keeps it finite)
+    transfer["reduction_factor"] = round(base / max(tail, 1), 2)
+
+    ten_k = next((w for w in widths if w["n_ch"] >= 10000), None)
+    report = {
+        "metric": "sharded_streaming_scale",
+        "config": {
+            "fs": SCALE_FS,
+            "dt_out": SCALE_DT_OUT,
+            "file_sec": SCALE_FILE_SEC,
+            "rounds": rounds,
+            "mesh": mesh_n,
+            "carry_save_every": save_every,
+            "host_cores": n_cores,
+            "spool_format": "tdas int16",
+        },
+        "widths": widths,
+        "host_transfer": transfer,
+        "headline_source": "tpudas.obs.registry",
+        "all_outputs_byte_identical": all(
+            w["outputs_byte_identical"] for w in widths
+        ),
+        "realtime_factor_10k": (
+            None if ten_k is None
+            else {m: ten_k[m]["realtime_factor"]
+                  for m in ("single", "sharded")}
+        ),
+        "note": (
+            "sharded_speedup needs spare cores: with <= mesh-width "
+            "physical cores the single-device XLA run already "
+            "saturates the machine and channel sharding can only tie "
+            "it (PERF.md 'Sharded streaming: when sharding loses')"
+        ),
+        "bench_wall_s": round(time.perf_counter() - t_bench0, 2),
+    }
+    with open(out_path, "w") as fh:
+        json.dump(report, fh, indent=1)
+        fh.write("\n")
+    print(json.dumps(report))
+    return report
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument(
-        "--out", default=os.path.join(REPO, "BENCH_pr02.json")
-    )
+    ap.add_argument("--out", default=None)
     ap.add_argument("--rounds", type=int, default=4)
     ap.add_argument("--files-per-round", type=int, default=2)
+    ap.add_argument(
+        "--channels", default=None,
+        help="comma-separated channel widths: switches to the "
+        "ISSUE 7 scale sweep (BENCH_pr07.json)",
+    )
+    ap.add_argument(
+        "--mesh", type=int, default=4,
+        help="channel-shard count for the scale sweep's sharded mode",
+    )
+    ap.add_argument(
+        "--save-every", type=int, default=4,
+        help="deferred carry-save cadence measured by the scale sweep",
+    )
     args = ap.parse_args()
+    if args.channels:
+        if args.save_every < 2:
+            ap.error(
+                "--save-every must be >= 2 in scale mode: the "
+                "host-transfer section compares it against the "
+                "every-round baseline"
+            )
+        channels = [int(c) for c in args.channels.split(",") if c]
+        report = run_scale(
+            args.out or os.path.join(REPO, "BENCH_pr07.json"),
+            channels, args.mesh, rounds=args.rounds,
+            save_every=args.save_every,
+        )
+        ok = (
+            report["all_outputs_byte_identical"]
+            and report["host_transfer"][
+                f"save_every_{args.save_every}"
+            ]["non_save_rounds_move_zero_bytes"]
+            and (report["host_transfer"]["reduction_factor"] or 0) > 1.0
+        )
+        sys.exit(0 if ok else 1)
     report = run(
-        args.out, rounds=args.rounds, files_per_round=args.files_per_round
+        args.out or os.path.join(REPO, "BENCH_pr02.json"),
+        rounds=args.rounds, files_per_round=args.files_per_round
     )
     # loud, parseable verdict for CI
     ok = (

@@ -123,8 +123,47 @@ def fft_stream_init(edge: int, n_ch: int) -> np.ndarray:
     return np.zeros((2 * int(edge), int(n_ch)), np.float32)
 
 
+@functools.lru_cache(maxsize=128)
+def _build_fft_stream_fn(T, rows_carry, n_ch, d_sec, low, high, order,
+                         mesh, ch_axis):
+    """jit-compiled FFT stream step: (block (T, C), carry (2*edge, C))
+    -> (filtered (T, C), new_carry).  Both inputs are donated on
+    accelerator backends (the caller never reuses either).
+
+    With ``mesh``, the step runs under ``shard_map`` with channels
+    split over ``ch_axis`` — the filter is column-independent (one
+    rfft/irfft batch per channel), so each device runs the identical
+    kernel on its local channel block and the sharded result is
+    byte-identical to the single-device step.  ``n_ch`` is then the
+    PADDED global channel count (tpudas.parallel.sharding's
+    pad-and-mask layout)."""
+    edge = rows_carry // 2
+
+    def fn(block, carry):
+        xc = jnp.concatenate(
+            [carry.astype(jnp.float32), block.astype(jnp.float32)],
+            axis=0,
+        )
+        filt = fft_pass_filter(xc, d_sec, low=low, high=high, order=order)
+        return filt[edge : edge + T], xc[xc.shape[0] - 2 * edge :]
+
+    body = fn
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+
+        from tpudas.parallel.compat import shard_map
+
+        spec = P(None, ch_axis)
+        body = shard_map(
+            fn, mesh=mesh, in_specs=(spec, spec),
+            out_specs=(spec, spec), check_vma=False,
+        )
+    donate = (0, 1) if jax.default_backend() not in ("cpu",) else ()
+    return jax.jit(body, donate_argnums=donate)
+
+
 def fft_pass_filter_stream(block, carry, d_sec, low=None, high=None,
-                           order=4):
+                           order=4, mesh=None, ch_axis="ch"):
     """One streaming step of the zero-phase FFT band filter.
 
     block: (T, C) new input samples; carry: (2*edge, C) from
@@ -136,26 +175,68 @@ def fft_pass_filter_stream(block, carry, d_sec, low=None, high=None,
     clean).  With a zero-initialized carry the first ``edge`` emitted
     samples read pre-stream silence; callers discard them exactly as
     the batch path discards its stream-start edge.
-    """
-    carry = jnp.asarray(carry, jnp.float32)
-    block = jnp.asarray(block, jnp.float32)
-    if carry.ndim != 2 or carry.shape[0] % 2:
+
+    Neither the block nor the previous carry may be reused after the
+    call: both are DONATED on accelerator backends (the returned
+    carry replaces the old one — feed it back verbatim and it stays
+    device-resident with no host round-trip).
+
+    With ``mesh``, channels are split over the mesh's ``ch_axis``
+    (zero-communication shard_map; pad-and-mask for non-divisible
+    counts) and the returned carry is a SHARDED device array — feed it
+    back verbatim and it stays resident on the mesh with no host
+    round-trip; ``filtered`` is trimmed to the logical channel count.
+    Byte-identical to the single-device step (the filter is
+    column-independent)."""
+    rows_carry = int(np.shape(carry)[0])
+    if len(np.shape(carry)) != 2 or rows_carry % 2:
         raise ValueError(
-            f"carry must be (2*edge, C), got {tuple(carry.shape)}"
+            f"carry must be (2*edge, C), got {tuple(np.shape(carry))}"
         )
-    if block.ndim != 2 or block.shape[1] != carry.shape[1]:
-        raise ValueError(
-            f"block {tuple(block.shape)} does not match carry "
-            f"{tuple(carry.shape)}"
-        )
+    T = int(np.shape(block)[0])
     from tpudas.obs.trace import span
 
-    edge = carry.shape[0] // 2
-    with span("op.fft_stream", rows=int(block.shape[0]), edge=int(edge)):
-        xc = jnp.concatenate([carry, block], axis=0)
-        filt = fft_pass_filter(xc, d_sec, low=low, high=high, order=order)
-        out = filt[edge : edge + block.shape[0]]
-    return out, xc[xc.shape[0] - 2 * edge :]
+    edge = rows_carry // 2
+    if mesh is None:
+        carry = jnp.asarray(carry, jnp.float32)
+        block = jnp.asarray(block, jnp.float32)
+        if block.ndim != 2 or block.shape[1] != carry.shape[1]:
+            raise ValueError(
+                f"block {tuple(block.shape)} does not match carry "
+                f"{tuple(carry.shape)}"
+            )
+        fn = _build_fft_stream_fn(
+            T, rows_carry, int(block.shape[1]),
+            float(d_sec), low, high, int(order), None, ch_axis,
+        )
+        with span("op.fft_stream", rows=T, edge=edge):
+            return fn(block, carry)
+    from tpudas.parallel.sharding import channel_pad, place_block
+
+    C = int(np.shape(block)[1])
+    C_carry = int(np.shape(carry)[1])
+    Cp = C + channel_pad(C, mesh, ch_axis)
+    if C_carry not in (C, Cp):
+        raise ValueError(
+            f"block {(T, C)} does not match carry "
+            f"{tuple(np.shape(carry))}"
+        )
+    xs = place_block(block, mesh, ch_axis)
+    if C_carry != Cp:
+        # first call after open/resume: the carry is a host array at
+        # the logical width — pad-and-place it once; every later step
+        # feeds back the sharded carry this step returns
+        carry = place_block(np.asarray(carry, np.float32), mesh, ch_axis)
+    fn = _build_fft_stream_fn(
+        T, rows_carry, Cp, float(d_sec), low, high, int(order),
+        mesh, ch_axis,
+    )
+    with span(
+        "op.fft_stream", rows=T, edge=edge,
+        shards=int(mesh.shape[ch_axis]),
+    ):
+        out, new_carry = fn(xs, carry)
+    return (out[:, :C] if Cp != C else out), new_carry
 
 
 def _host_sosfiltfilt(data, d_sec, low, high, order):

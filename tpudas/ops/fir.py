@@ -268,10 +268,24 @@ def design_cascade(
 
 
 def _polyphase_stage_xla(x, hb, R, n_out):
-    """One causal decimating stage on (T, C) data via shifted matmuls:
+    """One causal decimating stage on (T, C) data:
     ``y[k, c] = sum_j h[j] x[k*R + j, c]`` for k in [0, n_out).
 
     hb is the (B, R) frame-blocked tap matrix (zero-padded taps).
+
+    Phase-contracted formulation: one contraction over the tap phase
+    ``r`` for ALL frames at once (``u[b, m] = <x frame m, hb[b]>``),
+    then a B-term shifted sum over the small decimated frames.  The
+    naive form (B shifted einsums over the full-rate input) re-reads
+    the input B times; this reads it once plus ~B/R of it for ``u`` —
+    the streaming stage is memory-bound at production widths, and the
+    rewrite measures ~3x faster on stage 0 of the 1 kHz flagship plan
+    at 10k channels on CPU (PERF.md
+    "Sharded streaming").  The b-loop accumulates in the same order as
+    the naive form, and each b-term is the same dot over ``r``, so
+    per-element float arithmetic is unchanged in structure (the stage
+    remains deterministic and layout-independent: channel columns are
+    independent, which is what makes channel sharding bit-exact).
     """
     import jax.numpy as jnp
 
@@ -281,9 +295,10 @@ def _polyphase_stage_xla(x, hb, R, n_out):
     if need > T:
         x = jnp.pad(x, ((0, need - T), (0, 0)))
     xr = x[:need].reshape(n_out + B, R, x.shape[1])
+    u = jnp.einsum("mrc,br->bmc", xr, hb)
     y = jnp.zeros((n_out, x.shape[1]), x.dtype)
     for b in range(B):
-        y = y + jnp.einsum("krc,r->kc", xr[b : b + n_out], hb[b])
+        y = y + u[b, b : b + n_out]
     return y
 
 
@@ -727,17 +742,32 @@ def stream_stage_engines(plan: CascadePlan, T: int, n_ch: int,
 
 @functools.lru_cache(maxsize=128)
 def _build_stream_cascade_fn(plan: CascadePlan, T: int, n_ch: int,
-                             engine: str):
+                             engine: str, mesh=None, ch_axis="ch"):
     """jit-compiled stateful step: (x (T, C), carry) -> (y (T/ratio, C),
-    new_carry).  The carry is donated on accelerator backends — the
-    buffers are dead the moment the step returns, so steady-state
-    streaming allocates nothing per round."""
+    new_carry).  Both the input block and the carry are donated on
+    accelerator backends — every buffer fed in is dead the moment the
+    step returns, so steady-state streaming neither double-buffers the
+    carry update nor holds the consumed input block in HBM.
+
+    With ``mesh``, the step runs under ``shard_map`` with channels
+    split over the mesh's ``ch_axis`` — the zero-communication layout:
+    every stage (and its carry leaf) is channel-independent, so each
+    device runs the identical per-stage loop on its local channel
+    block and the sharded output/carry are byte-identical to the
+    single-device step.  ``n_ch`` is then the PADDED global channel
+    count (a multiple of the shard count; see
+    tpudas.parallel.sharding's pad-and-mask layout)."""
     import jax
     import jax.numpy as jnp
 
     blocked = _blocked_taps(plan)
     sizes = stream_carry_sizes(plan)
-    use_pallas = _stream_stage_pallas(plan, T, n_ch, engine)
+    # Pallas thresholds see what one device actually traces: the
+    # LOCAL channel count under a mesh
+    n_ch_local = (
+        n_ch // int(mesh.shape[ch_axis]) if mesh is not None else n_ch
+    )
+    use_pallas = _stream_stage_pallas(plan, T, n_ch_local, engine)
     interpret = _pallas_interpret() if any(use_pallas) else False
 
     def fn(x, carry):
@@ -758,24 +788,49 @@ def _build_stream_cascade_fn(plan: CascadePlan, T: int, n_ch: int,
             x = y
         return x, tuple(new_carry)
 
-    donate = (1,) if jax.default_backend() not in ("cpu",) else ()
-    return jax.jit(fn, donate_argnums=donate)
+    body = fn
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+
+        from tpudas.parallel.compat import shard_map
+
+        spec = P(None, ch_axis)
+        carry_specs = tuple(spec for _ in sizes)
+        body = shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(spec, carry_specs),
+            out_specs=(spec, carry_specs),
+            check_vma=False,
+        )
+    donate = (0, 1) if jax.default_backend() not in ("cpu",) else ()
+    return jax.jit(body, donate_argnums=donate)
 
 
-def cascade_decimate_stream(x, carry, plan: CascadePlan, engine="auto"):
+def cascade_decimate_stream(x, carry, plan: CascadePlan, engine="auto",
+                            mesh=None, ch_axis="ch"):
     """One stateful streaming step of the cascade.
 
     x: (T, C) float32 block, T a multiple of ``plan.ratio``; ``carry``
     from :func:`cascade_stream_init` or a previous step.  Returns
     ``(y (T/ratio, C), new_carry)`` — see the streamed-output contract
-    in the section comment above.  The previous carry must not be
-    reused after the call (its buffers are donated on accelerators).
+    in the section comment above.  Neither the previous carry nor the
+    input block may be reused after the call (both are donated on
+    accelerators).
+
+    With ``mesh``, channels are split over the mesh's ``ch_axis``
+    (zero-communication shard_map; pad-and-mask for non-divisible
+    counts) and the returned carry leaves are SHARDED device arrays —
+    feed them back verbatim and they stay resident on the mesh with no
+    host round-trip; ``y`` is trimmed to the logical channel count.
+    The sharded step is byte-identical to the single-device step
+    (channel columns are independent; tests/test_parallel.py pins it).
     """
     import jax.numpy as jnp
 
     engine = resolve_cascade_engine(engine)
-    x = jnp.asarray(x)
-    T = int(x.shape[0])
+    x = jnp.asarray(x) if mesh is None else x
+    T = int(np.shape(x)[0])
     if T % plan.ratio:
         raise ValueError(
             f"stream block length {T} is not a multiple of the "
@@ -791,9 +846,37 @@ def cascade_decimate_stream(x, carry, plan: CascadePlan, engine="auto"):
         )
     from tpudas.obs.trace import span
 
-    fn = _build_stream_cascade_fn(plan, T, int(x.shape[1]), engine)
-    with span("op.cascade_stream", rows=T, engine=engine):
-        return fn(x, tuple(jnp.asarray(b, jnp.float32) for b in carry))
+    if mesh is None:
+        fn = _build_stream_cascade_fn(plan, T, int(x.shape[1]), engine)
+        with span("op.cascade_stream", rows=T, engine=engine):
+            return fn(x, tuple(jnp.asarray(b, jnp.float32) for b in carry))
+    from tpudas.parallel.sharding import (
+        channel_pad,
+        place_block,
+        place_carry_leaves,
+    )
+
+    C = int(np.shape(x)[1])
+    Cp = C + channel_pad(C, mesh, ch_axis)
+    if any(int(np.shape(b)[1]) not in (C, Cp) for b in carry):
+        raise ValueError(
+            f"stream carry channel width {[np.shape(b) for b in carry]} "
+            f"matches neither the block ({C}) nor the padded shard "
+            f"layout ({Cp})"
+        )
+    xs = place_block(x, mesh, ch_axis)
+    if any(int(np.shape(b)[1]) != Cp for b in carry):
+        # first call after open/resume: the leaves are host arrays at
+        # the logical width — pad-and-place them once; every later
+        # round feeds back the sharded leaves this step returns
+        carry = place_carry_leaves(carry, mesh, ch_axis)
+    fn = _build_stream_cascade_fn(plan, T, Cp, engine, mesh, ch_axis)
+    with span(
+        "op.cascade_stream", rows=T, engine=engine,
+        shards=int(mesh.shape[ch_axis]),
+    ):
+        y, bufs = fn(xs, tuple(carry))
+    return (y[:, :C] if Cp != C else y), bufs
 
 
 # ---------------------------------------------------------------------------

@@ -18,7 +18,7 @@ becomes explicit here, the TPU way:
   (:mod:`tpudas.parallel.distributed`).
 """
 
-from tpudas.parallel.mesh import make_mesh, device_count
+from tpudas.parallel.mesh import make_mesh, device_count, resolve_mesh
 from tpudas.parallel.sharding import shard_channels, channel_sharding
 from tpudas.parallel.halo import exchange_halo_time
 from tpudas.parallel.pipeline import sharded_lowpass_decimate
@@ -27,6 +27,7 @@ from tpudas.parallel.batch import batched_rolling_mean
 __all__ = [
     "make_mesh",
     "device_count",
+    "resolve_mesh",
     "shard_channels",
     "channel_sharding",
     "exchange_halo_time",

@@ -14,7 +14,29 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["exchange_halo_time"]
+__all__ = ["exchange_halo_time", "fir_halo_rows"]
+
+
+def fir_halo_rows(plan, n_loc: int, n_ch_local: int = 1,
+                  engine: str = "auto") -> int:
+    """One-sided (look-ahead) halo width, in full-rate input rows, a
+    time shard must receive from its right neighbor so its ``n_loc``
+    local cascade outputs have their complete filter support.
+
+    The math from the taps: a stage with ``len(h)`` taps and ratio
+    ``R`` reads ``ceil(len(h)/R)`` frames per output, so producing
+    ``k`` outputs consumes ``(k + B - 1) * R`` inputs with
+    ``B = ceil(len(h)/R)`` — telescoped over the cascade this is
+    :func:`tpudas.ops.fir.chain_layout`'s input-rows number, and the
+    halo is whatever exceeds the shard's own ``n_loc * ratio`` rows
+    (Pallas stages consume grid-rounded inputs, so ``n_ch_local`` /
+    ``engine`` must describe the layout the shard body will trace).
+    Matches ``tpudas.parallel.pipeline.sharded_cascade_layout``.
+    """
+    from tpudas.ops.fir import chain_layout
+
+    _, rows_local = chain_layout(plan, int(n_loc), int(n_ch_local), engine)
+    return rows_local - int(n_loc) * int(plan.ratio)
 
 
 def exchange_halo_time(block, halo: int, axis_name: str = "time",

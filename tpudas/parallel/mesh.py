@@ -2,12 +2,14 @@
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 import jax
 from jax.sharding import Mesh
 
-__all__ = ["make_mesh", "device_count"]
+__all__ = ["make_mesh", "device_count", "resolve_mesh"]
 
 
 def device_count() -> int:
@@ -33,3 +35,40 @@ def make_mesh(n_devices=None, time_shards=1, axis_names=("time", "ch")) -> Mesh:
         )
     grid = np.array(devices).reshape(time_shards, n // time_shards)
     return Mesh(grid, axis_names)
+
+
+def resolve_mesh(mesh=None, env="TPUDAS_MESH"):
+    """Driver-facing mesh resolution: the one place ``mesh=`` /
+    ``TPUDAS_MESH=N`` turn into a :class:`jax.sharding.Mesh`.
+
+    - ``Mesh`` instance: returned as-is;
+    - int ``N`` (or ``TPUDAS_MESH=N`` when ``mesh is None``): a pure
+      channel-sharding mesh over the first N devices
+      (:func:`make_mesh` with ``time_shards=1``);
+    - ``None`` / ``0`` / ``1``: no mesh (single-device execution).
+
+    Also sets the ``tpudas_parallel_shards`` gauge to the resolved
+    channel-shard count (1 when unsharded) so an operator can read the
+    active layout off ``/metrics`` without knowing the config.
+    """
+    if mesh is None:
+        raw = os.environ.get(env, "").strip()
+        if raw:
+            mesh = int(raw)
+    if isinstance(mesh, (int, np.integer)):
+        n = int(mesh)
+        if n < 0:
+            raise ValueError(f"mesh device count must be >= 0, got {n}")
+        if n > len(jax.devices()):
+            raise ValueError(
+                f"mesh={n} exceeds the {len(jax.devices())} available "
+                "devices"
+            )
+        mesh = None if n in (0, 1) else make_mesh(n)
+    from tpudas.obs.registry import get_registry
+
+    get_registry().gauge(
+        "tpudas_parallel_shards",
+        "channel shards of the active mesh (1 = unsharded)",
+    ).set(1 if mesh is None else int(mesh.shape.get("ch", 1)))
+    return mesh

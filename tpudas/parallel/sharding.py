@@ -4,14 +4,50 @@ Every tpudas kernel operates independently per channel, so a
 ``(time, channel)`` block sharded as ``P(None, "ch")`` runs the jitted
 kernels with NO collectives — XLA partitions the FFT / gather /
 reduce_window column-wise automatically. This is the first-choice
-production layout (BASELINE.json: "channels sharded over v5e-8")."""
+production layout (BASELINE.json: "channels sharded over v5e-8").
+
+Non-divisible channel counts take the **pad-and-mask** layout (the
+alternative — a ragged last shard — would compile a distinct kernel
+per shard shape): the channel axis is zero-padded up to a multiple of
+the shard count before placement and the pad columns are dropped when
+a result is gathered back (:func:`pad_channels` / the ``n_ch`` trim in
+callers).  Padding with zeros is exact for every tpudas kernel —
+channels are independent, so the real columns never see the pad — and
+a zero input column stays zero through the linear filters, so padded
+carry state trims back to the unpadded bytes."""
 
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-__all__ = ["channel_sharding", "shard_channels"]
+from tpudas.obs.registry import get_registry
+from tpudas.obs.trace import span
+
+__all__ = [
+    "channel_sharding",
+    "shard_channels",
+    "channel_pad",
+    "pad_channels",
+    "place_block",
+    "place_carry_leaves",
+    "gather_leaves",
+    "is_device_resident",
+]
+
+
+def _count_transfer(direction: str, nbytes: int) -> None:
+    """Host<->device traffic accounting for the sharded stream path:
+    the bench reads these to prove the steady round no longer
+    round-trips the carry pytree through host memory."""
+    get_registry().counter(
+        "tpudas_parallel_transfer_bytes_total",
+        "bytes explicitly moved between host and the mesh by the "
+        "sharded streaming path",
+        labelnames=("direction",),
+    ).inc(int(nbytes), direction=direction)
 
 
 def channel_sharding(mesh, ch_axis="ch") -> NamedSharding:
@@ -23,3 +59,76 @@ def channel_sharding(mesh, ch_axis="ch") -> NamedSharding:
 def shard_channels(array, mesh, ch_axis="ch"):
     """Place a (T, C) array with channels sharded over the mesh."""
     return jax.device_put(array, channel_sharding(mesh, ch_axis))
+
+
+def channel_pad(n_ch: int, mesh, ch_axis="ch") -> int:
+    """Zero columns appended to an ``n_ch``-channel array so the
+    channel axis splits evenly over the mesh (pad-and-mask layout)."""
+    return -int(n_ch) % int(mesh.shape[ch_axis])
+
+
+def pad_channels(x, mesh, ch_axis="ch"):
+    """Zero-pad the channel axis (last axis) of ``x`` to the shard
+    multiple.  Host arrays pad on host (cheap, pre-transfer); traced /
+    device arrays pad with jnp."""
+    pad = channel_pad(np.shape(x)[-1], mesh, ch_axis)
+    if not pad:
+        return x
+    widths = [(0, 0)] * (np.ndim(x) - 1) + [(0, pad)]
+    if isinstance(x, np.ndarray):
+        return np.pad(x, widths)
+    return jnp.pad(x, widths)
+
+
+def place_block(x, mesh, ch_axis="ch"):
+    """Pad-and-place one (T, C) input block for the sharded stream
+    step: channels split over ``ch_axis``, time replicated.  The
+    explicit ``device_put`` (vs letting jit transfer lazily) keeps the
+    H2D cost visible under the ``parallel.place`` span."""
+    with span("parallel.place", rows=int(np.shape(x)[0])):
+        padded = pad_channels(np.asarray(x, np.float32), mesh, ch_axis)
+        _count_transfer("place", padded.nbytes)
+        return shard_channels(padded, mesh, ch_axis)
+
+
+def place_carry_leaves(bufs, mesh, ch_axis="ch"):
+    """Pad-and-place a tuple of per-stage carry leaves ((p_i, C)
+    each) onto the mesh — used once at stream open / resume; after
+    that the leaves live on-device (the stream step returns sharded
+    leaves and the driver only gathers on the save cadence)."""
+    sharding = channel_sharding(mesh, ch_axis)
+    with span("parallel.place", leaves=len(bufs)):
+        out = []
+        for b in bufs:
+            padded = pad_channels(np.asarray(b, np.float32), mesh, ch_axis)
+            _count_transfer("place", padded.nbytes)
+            out.append(jax.device_put(padded, sharding))
+        return tuple(out)
+
+
+def is_device_resident(x) -> bool:
+    """True for a jax device array (the sharded carry leaves the
+    stream step returns), False for host numpy — what save cadences
+    and the bench use to tell a gather apart from a no-op copy."""
+    return isinstance(x, jax.Array)
+
+
+def gather_leaves(bufs, n_ch: int | None = None):
+    """Gather a tuple of (possibly sharded, possibly pad-and-masked)
+    carry leaves back to host numpy, trimming the channel axis to the
+    logical ``n_ch`` — the serialization form: byte-identical to the
+    leaves a single-device run carries.  Host traffic is counted
+    (``tpudas_parallel_transfer_bytes_total{direction="gather"}``)
+    and the call runs under the ``parallel.gather`` span so the save
+    cadence's D2H cost is visible."""
+    moved = sum(int(np.size(b)) * 4 for b in bufs if is_device_resident(b))
+    with span("parallel.gather", leaves=len(bufs)):
+        if moved:
+            _count_transfer("gather", moved)
+        out = []
+        for b in bufs:
+            arr = np.asarray(b, np.float32)
+            if n_ch is not None and arr.ndim == 2 and arr.shape[1] > n_ch:
+                arr = np.ascontiguousarray(arr[:, : int(n_ch)])
+            out.append(arr)
+        return tuple(out)

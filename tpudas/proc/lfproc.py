@@ -362,7 +362,9 @@ class LFProc:
         split over the mesh's ``"ch"`` axis (zero communication), and —
         when the mesh has a ``"time"`` axis of size > 1 and the window
         is cascade-aligned — the time axis is sharded too, with halo
-        exchange over ICI neighbors (tpudas.parallel.pipeline). ``None``
+        exchange over ICI neighbors (tpudas.parallel.pipeline). The
+        stateful stream path (:meth:`process_stream_increment`) shards
+        over a channel-only mesh with a device-resident carry. ``None``
         (default) runs single-device, as the reference does
         (lf_das.py:236 single-process select/broadcast)."""
         return self._mesh
@@ -455,7 +457,13 @@ class LFProc:
         carry), writing output files and advancing ``carry`` in place.
         Returns the number of output samples emitted.  Numerically
         matches :meth:`process_time_range` over the same span (the
-        batch path is the oracle; see tests/test_stream_state.py)."""
+        batch path is the oracle; see tests/test_stream_state.py).
+
+        With a channel-only :attr:`mesh`, the stream steps run under
+        ``shard_map`` with channels split over ``"ch"`` and the carry
+        leaves stay SHARDED on the mesh between calls (pad-and-mask at
+        non-divisible widths; byte-identical to the single-device run
+        — tests/test_parallel.py pins it end to end)."""
         if self._output_folder is None:
             raise Exception("Please setup output folder first")
         from tpudas.proc.stream import process_increment

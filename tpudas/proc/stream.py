@@ -14,6 +14,18 @@ stage — so each input sample is read and filtered exactly once:
   :func:`tpudas.ops.filter.fft_pass_filter_stream` plus the last
   filtered row (lerp continuity across block seams).
 
+The engine buffers are DEVICE-RESIDENT between rounds: each stream
+step returns jax arrays that are fed back verbatim (donated on
+accelerator backends, so steady-state streaming neither double-buffers
+the carry update nor round-trips it through host memory).  Under a
+channel-sharding mesh (``LFProc(mesh=...)`` with a ``time`` axis of
+size 1 — see tpudas.parallel) the leaves live sharded on the mesh in
+the pad-and-mask layout and each device runs the identical kernels on
+its local channel block, byte-identical to the single-device step.
+The pytree crosses to host only on the save cadence below, gathered
+and trimmed to the logical channel width so the serialized form never
+depends on the execution layout.
+
 Crash-only property preserved: the carry serializes to ONE ``.npz``
 beside the output files (meta embedded as JSON for atomicity, written
 tmp-then-rename with a crc32 ``.crc`` sidecar and a ``.prev`` double
@@ -151,12 +163,20 @@ def save_carry(carry: StreamCarry, folder: str) -> str:
     )
     from tpudas.resilience.faults import fault_point
 
+    from tpudas.parallel.sharding import gather_leaves
+
     path = os.path.join(folder, CARRY_FILENAME)
     fault_point("carry.save", folder=folder)
     with span("stream.carry_save"):
+        # the only point the engine buffers cross back to host: sharded
+        # (pad-and-masked) device leaves gather + trim to the logical
+        # channel width here, so the serialized .npz is byte-identical
+        # to a single-device run's.  D2H traffic is counted under
+        # tpudas_parallel_transfer_bytes_total{direction="gather"} —
+        # raise TPUDAS_CARRY_SAVE_EVERY to amortize it at 10k channels.
         arrays = {"meta": np.asarray(json.dumps(carry._meta()))}
-        for i, b in enumerate(carry.bufs):
-            arrays[f"buf_{i}"] = np.asarray(b, np.float32)
+        for i, b in enumerate(gather_leaves(carry.bufs, carry.n_ch)):
+            arrays[f"buf_{i}"] = b
         if carry.residual is not None:
             arrays["residual"] = np.asarray(carry.residual, np.float32)
         buf = _io.BytesIO()
@@ -686,6 +706,19 @@ def _count_block(rows: int, engine: str, t_dev: float) -> None:
     ).observe(t_dev, engine=engine)
 
 
+def _stream_mesh(lfp):
+    """The channel-sharding mesh the stream step runs under: the
+    LFProc's mesh when it is pure channel sharding (a ``time`` axis of
+    size 1 — time-sharded meshes stay on the window path, which owns
+    the halo exchange), else None.  With a mesh, every engine carry
+    leaf lives as a sharded device array between rounds and only
+    crosses to host on the save cadence (:func:`save_carry`)."""
+    mesh = getattr(lfp, "_mesh", None)
+    if mesh is None or int(mesh.shape.get("time", 1)) > 1:
+        return None
+    return mesh
+
+
 def _pool_with_residual(carry: StreamCarry, new) -> np.ndarray:
     residual = (
         carry.residual
@@ -707,14 +740,22 @@ def _consume_cascade(lfp, carry: StreamCarry, patch, new) -> None:
     plan = design_cascade(
         1e9 / carry.d_ns, carry.ratio, _corner(carry.dt_out), carry.order
     )
+    mesh = _stream_mesh(lfp)
     pool = _pool_with_residual(carry, new)
     usable = pool.shape[0] - pool.shape[0] % carry.ratio
     eng_req = "auto" if (lfp._pallas_ok and carry.pallas_ok) else "xla"
+    # engine thresholds see what one device actually traces: the LOCAL
+    # (padded) channel count under a mesh
+    n_ch_eng = (
+        carry.n_ch
+        if mesh is None
+        else -(-carry.n_ch // int(mesh.shape["ch"]))
+    )
     off = 0
     for n_out in _pow2_blocks(usable // carry.ratio, carry.patch_out):
         blk = pool[off : off + n_out * carry.ratio]
         stages = stream_stage_engines(
-            plan, blk.shape[0], carry.n_ch, eng_req
+            plan, blk.shape[0], n_ch_eng, eng_req
         )
         ran = "cascade-pallas" if "pallas" in stages else "cascade-xla"
         # the stream step donates the carry on accelerators, so a
@@ -728,7 +769,7 @@ def _consume_cascade(lfp, carry: StreamCarry, patch, new) -> None:
         t0 = time.perf_counter()
         try:
             y, bufs = cascade_decimate_stream(
-                blk, carry.bufs, plan, eng_req
+                blk, carry.bufs, plan, eng_req, mesh=mesh
             )
         except Exception as exc:
             # mirror the batch path's Pallas resilience: a fast-path
@@ -745,7 +786,9 @@ def _consume_cascade(lfp, carry: StreamCarry, patch, new) -> None:
             carry.pallas_ok = False  # persists across rounds/restarts
             eng_req = "xla"
             ran = "cascade-xla"
-            y, bufs = cascade_decimate_stream(blk, backup, plan, eng_req)
+            y, bufs = cascade_decimate_stream(
+                blk, backup, plan, eng_req, mesh=mesh
+            )
         y = np.asarray(y)
         t_dev = time.perf_counter() - t0
         lfp.timings["device_s"] += t_dev
@@ -772,6 +815,7 @@ def _consume_fft(lfp, carry: StreamCarry, patch, new, t_new0_ns) -> None:
 
     d = carry.d_ns
     corner = _corner(carry.dt_out)
+    mesh = _stream_mesh(lfp)
     q = _FFT_QUANTUM
     pool = _pool_with_residual(carry, new)
     t_pool0_ns = t_new0_ns - (pool.shape[0] - new.shape[0]) * d
@@ -784,7 +828,8 @@ def _consume_fft(lfp, carry: StreamCarry, patch, new, t_new0_ns) -> None:
         blk = pool[off : off + n_units * q]
         t0 = time.perf_counter()
         filt, fcarry = fft_pass_filter_stream(
-            blk, carry.bufs[0], d / 1e9, high=corner, order=carry.order
+            blk, carry.bufs[0], d / 1e9, high=corner, order=carry.order,
+            mesh=mesh,
         )
         filt = np.asarray(filt)
         t_dev = time.perf_counter() - t0
@@ -803,7 +848,10 @@ def _consume_fft(lfp, carry: StreamCarry, patch, new, t_new0_ns) -> None:
             - (tail.shape[0]) * d
         )
         t_last = t_row0 + (rows.shape[0] - 1) * d
-        carry.bufs = (np.asarray(fcarry), rows[-1:].copy())
+        # the overlap-save carry stays a DEVICE array (sharded under a
+        # mesh) and is fed back verbatim next block — it only crosses
+        # to host on the save cadence; the 1-row lerp seam is host data
+        carry.bufs = (fcarry, rows[-1:].copy())
         carry.consumed += int(blk.shape[0])
         off += blk.shape[0]
         if t_last < carry.next_emit_ns or rows.shape[0] < 2:

@@ -342,6 +342,7 @@ def run_lowpass_realtime(
     rolling_window=None,
     rolling_step=None,
     stateful=None,
+    carry_save_every=None,
     health=None,
     fault_policy=None,
     quarantine=True,
@@ -355,8 +356,17 @@ def run_lowpass_realtime(
     / ``window_dp`` are forwarded to :class:`LFProc` (None keeps its
     defaults), so the
     streaming path can run the cascade engine and gap policies the batch
-    path has. ``mesh`` (a :class:`jax.sharding.Mesh`) runs each round's
-    windows device-sharded — see :attr:`LFProc.mesh`.  Pass a :class:`tpudas.utils.profiling.Counters` to
+    path has. ``mesh`` runs the round's device compute mesh-sharded: a
+    :class:`jax.sharding.Mesh`, an int ``N`` (channel sharding over the
+    first N devices), or — when None — ``TPUDAS_MESH=N`` from the
+    environment (see :func:`tpudas.parallel.mesh.resolve_mesh`).  A
+    channel-only mesh (no ``time`` axis > 1) keeps the STATEFUL path:
+    the stream carry lives as a sharded, donated, device-resident
+    pytree between rounds and outputs are byte-identical to the
+    single-device run (PERF.md "Sharded streaming"); a time-sharded
+    mesh falls back to the window/rewind path, which owns the halo
+    exchange — see :attr:`LFProc.mesh`.  Pass a
+    :class:`tpudas.utils.profiling.Counters` to
     accumulate throughput; each processing round also emits a
     ``realtime_round`` event with its own real-time factor.
 
@@ -374,8 +384,18 @@ def run_lowpass_realtime(
     rewind): each round processes ONLY new full-rate samples through
     :meth:`LFProc.process_stream_increment` and persists the O(1)
     carry beside the outputs for crash-only resume.  Joint products,
-    meshes, and window-DP stay on the rewind path, as does a legacy
-    output folder that has files but no carry.
+    time-sharded meshes, and window-DP stay on the rewind path, as
+    does a legacy output folder that has files but no carry.
+
+    ``carry_save_every`` (default 1, or ``TPUDAS_CARRY_SAVE_EVERY``)
+    persists the carry every Nth processing round instead of every
+    round, so steady-state rounds skip the device→host gather + crc
+    write entirely (the carry pytree stays on-device; at 10k channels
+    this is the dominant per-round host traffic).  Crash-resume is
+    unaffected in kind: a crash loses at most N-1 rounds of carry
+    progress, and :func:`tpudas.proc.stream.reconcile_outputs` deletes
+    the outputs past the saved carry on resume — they are regenerated
+    byte-identically.  A clean shutdown always flushes a final save.
 
     ``health`` (default: ``TPUDAS_HEALTH=1``) drops an atomic
     ``health.json`` + ``metrics.prom`` in ``output_folder`` after every
@@ -450,6 +470,9 @@ def run_lowpass_realtime(
         )
         if v is not None
     }
+    from tpudas.parallel.mesh import resolve_mesh
+
+    mesh = resolve_mesh(mesh)
     counters = counters if counters is not None else Counters()
     if health is None:
         health = os.environ.get("TPUDAS_HEALTH", "0") == "1"
@@ -482,10 +505,21 @@ def run_lowpass_realtime(
 
     if stateful is None:
         stateful = os.environ.get("TPUDAS_STREAM_STATEFUL", "1") != "0"
+    # a channel-only mesh keeps the stateful path (the carry shards
+    # over it, device-resident); a time-sharded mesh falls back to the
+    # window/rewind path, which owns the halo exchange
     stateful = bool(stateful) and (
-        rolling_output_folder is None and mesh is None and not window_dp
+        rolling_output_folder is None
+        and not window_dp
+        and (mesh is None or int(mesh.shape.get("time", 1)) <= 1)
     )
+    if carry_save_every is None:
+        carry_save_every = int(
+            os.environ.get("TPUDAS_CARRY_SAVE_EVERY", "") or 1
+        )
+    carry_save_every = max(1, int(carry_save_every))
     carry = None  # the cross-round filter state (stateful mode)
+    carry_unsaved = 0  # completed rounds since the last carry save
     carry_checked = False  # disk/legacy resolution happens once
     rewind_wrote = False  # first rewind write invalidates any carry
     pyr_state = {"store": None}  # cross-round open tile store (pyramid)
@@ -658,8 +692,14 @@ def run_lowpass_realtime(
 
                         # saved AFTER the outputs: the carry is never ahead
                         # of the files (crash-only; resume reconciles the
-                        # rest)
-                        save_carry(carry, output_folder)
+                        # rest).  On a >1 cadence the skipped rounds keep
+                        # the pytree on-device — a crash simply resumes
+                        # from the last save and regenerates the tail
+                        # byte-identically.
+                        carry_unsaved += 1
+                        if carry_unsaved >= carry_save_every:
+                            save_carry(carry, output_folder)
+                            carry_unsaved = 0
                     else:
                         resumed_stateful = False
                         if not rewind_wrote:
@@ -829,6 +869,7 @@ def run_lowpass_realtime(
                 if stateful:
                     carry = None
                     carry_checked = False
+                    carry_unsaved = 0
                 pyr_state["store"] = None
                 det_state["pipe"] = None
                 edge_health.write(
@@ -860,6 +901,15 @@ def run_lowpass_realtime(
             "stateful" if stateful else "rewind", 0.0, None,
         )
         raise
+    # clean termination: flush a deferred carry save (cadence > 1) so
+    # the next process resumes from the true head instead of replaying
+    # the last few rounds — crash paths skip this on purpose (a
+    # mid-increment carry may be ahead of the written outputs)
+    if stateful and carry is not None and carry_unsaved:
+        from tpudas.proc.stream import save_carry
+
+        save_carry(carry, output_folder)
+        carry_unsaved = 0
     # final snapshot on clean termination: quarantine/degradation state
     # from the LAST poll (a file can be quarantined by the very poll
     # that terminates the loop) must be visible to the operator
@@ -899,7 +949,10 @@ def run_rolling_realtime(
     file — rolling_mean_dascore_edge.ipynb:209-221). Returns rounds
     that processed data.
 
-    ``mesh`` batches each round's fresh patches over the mesh's ``ch``
+    ``mesh`` (a :class:`jax.sharding.Mesh`, an int device count, or
+    ``TPUDAS_MESH=N`` from the environment — see
+    :func:`tpudas.parallel.mesh.resolve_mesh`) batches each round's
+    fresh patches over the mesh's ``ch``
     axis (pure data parallelism, no collectives) in bounded chunks,
     whenever the chunk is shape-uniform and ``engine`` is not a host
     engine ("numpy"/"host" forces the per-patch host path);
@@ -927,7 +980,9 @@ def run_rolling_realtime(
     import os
 
     from tpudas.core import units as _units
+    from tpudas.parallel.mesh import resolve_mesh
 
+    mesh = resolve_mesh(mesh)
     if mesh is not None and "ch" not in mesh.shape:
         raise ValueError(
             "run_rolling_realtime mesh needs a 'ch' axis (use "
