@@ -1,0 +1,637 @@
+"""tpudas.fleet: the multi-array round engine (ISSUE 8).
+
+N=3 interleaved streams through one FleetEngine: byte-identity of
+every stream against its own single-stream control, deficit
+round-robin fairness under one stalled spool, mid-fleet
+KeyboardInterrupt crash + resume byte-identity, fleet fsck
+classify/repair across stream roots, `/s/<id>/...` routing +
+`/fleet/healthz` aggregation, deterministic poll jitter, and the
+driver-parity lint (tools/check_driver_parity.py) wired into tier-1.
+"""
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpudas.core.timeutils import to_datetime64
+from tpudas.fleet import (
+    FleetEngine,
+    PollJitter,
+    StreamConfig,
+    StreamSpec,
+)
+from tpudas.io.registry import write_patch
+from tpudas.testing import (
+    FaultPlan,
+    FaultSpec,
+    install_fault_plan,
+    synthetic_patch,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import check_driver_parity  # noqa: E402
+
+FS = 100.0
+FILE_SEC = 30.0
+NCH = 6
+T0 = "2023-03-22T00:00:00"
+
+
+def _feed(directory, start_index, count, noise=0.01):
+    """Append ``count`` contiguous files (one stream's interrogator
+    cadence); ``noise`` differentiates stream content."""
+    os.makedirs(directory, exist_ok=True)
+    t0 = to_datetime64(T0).astype("datetime64[ns]")
+    step = np.timedelta64(int(round(1e9 / FS)), "ns")
+    n = int(FILE_SEC * FS)
+    for i in range(start_index, start_index + count):
+        p = synthetic_patch(
+            t0=t0 + i * n * step, duration=FILE_SEC, fs=FS, n_ch=NCH,
+            seed=i, phase_origin=t0, noise=noise,
+        )
+        write_patch(p, os.path.join(directory, f"raw_{i:04d}.h5"))
+
+
+def _lowpass_config(**overrides):
+    base = dict(
+        kind="lowpass",
+        start_time=T0,
+        output_sample_interval=1.0,
+        edge_buffer=8.0,
+        process_patch_size=40,
+        poll_interval=0.0,
+    )
+    base.update(overrides)
+    return StreamConfig(**base)
+
+
+def _run_control(source, out, feed_fn=None, **overrides):
+    """One single-stream control via the legacy driver (the shim —
+    i.e. the same runner code, driven alone)."""
+    from tpudas.proc.streaming import run_lowpass_realtime
+
+    state = {"called": False}
+
+    def sleep(_):
+        if not state["called"]:
+            state["called"] = True
+            if feed_fn is not None:
+                feed_fn()
+
+    kwargs = dict(
+        source=source,
+        output_folder=out,
+        start_time=T0,
+        output_sample_interval=1.0,
+        edge_buffer=8.0,
+        process_patch_size=40,
+        poll_interval=0.0,
+        sleep_fn=sleep,
+    )
+    kwargs.update(overrides)
+    return run_lowpass_realtime(**kwargs)
+
+
+def _output_shas(folder) -> dict:
+    """{name: sha256} of the emitted .h5 product files."""
+    out = {}
+    for name in sorted(os.listdir(folder)):
+        if name.startswith("LFDAS_") and name.endswith(".h5"):
+            with open(os.path.join(folder, name), "rb") as fh:
+                out[name] = hashlib.sha256(fh.read()).hexdigest()
+    return out
+
+
+def _pyramid_shas(folder) -> dict:
+    """{relpath: sha256} of the tile pyramid (``.prev``/tmp excluded —
+    append-schedule dependent, same rule as tools/crash_drill.py)."""
+    from tpudas.serve.tiles import TILE_DIRNAME
+    from tpudas.utils.atomicio import is_tmp_name
+
+    tiles = os.path.join(folder, TILE_DIRNAME)
+    out = {}
+    for dirpath, _d, filenames in os.walk(tiles):
+        for name in sorted(filenames):
+            if ".prev" in name or is_tmp_name(name):
+                continue
+            path = os.path.join(dirpath, name)
+            with open(path, "rb") as fh:
+                out[os.path.relpath(path, tiles)] = hashlib.sha256(
+                    fh.read()
+                ).hexdigest()
+    return out
+
+
+class TestConfig:
+    def test_lowpass_requires_core_fields(self):
+        with pytest.raises(ValueError, match="start_time"):
+            StreamConfig(kind="lowpass")
+
+    def test_rolling_requires_window_step(self):
+        with pytest.raises(ValueError, match="window and step"):
+            StreamConfig(kind="rolling")
+
+    def test_joint_params_need_rolling_folder(self):
+        with pytest.raises(ValueError, match="rolling_output_folder"):
+            _lowpass_config(rolling_window=3.0)
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="kind"):
+            StreamConfig(kind="median")
+
+    def test_stream_id_alphabet(self):
+        cfg = StreamConfig(kind="rolling", window=1.0, step=1.0)
+        with pytest.raises(ValueError, match="stream_id"):
+            StreamSpec(stream_id="../escape", source=".", config=cfg)
+        with pytest.raises(ValueError, match="stream_id"):
+            StreamSpec(stream_id=".hidden", source=".", config=cfg)
+
+    def test_duplicate_stream_ids_rejected(self, tmp_path):
+        cfg = StreamConfig(kind="rolling", window=1.0, step=1.0)
+        specs = [
+            StreamSpec(stream_id="a", source=".", config=cfg),
+            StreamSpec(stream_id="a", source=".", config=cfg),
+        ]
+        with pytest.raises(ValueError, match="duplicate"):
+            FleetEngine(str(tmp_path / "root"), specs)
+
+
+class TestDriverParityLint:
+    def test_repo_is_clean(self):
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "tools", "check_driver_parity.py"),
+            ],
+            capture_output=True,
+            text=True,
+            timeout=240,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "check_driver_parity: OK" in proc.stdout
+
+    def test_lint_reports_empty(self):
+        assert check_driver_parity.lint() == []
+
+
+class TestPollJitter:
+    def test_deterministic_per_stream(self):
+        a1 = [PollJitter("s0", 0.1).next_unit() for _ in range(1)]
+        a2 = [PollJitter("s0", 0.1).next_unit() for _ in range(1)]
+        assert a1 == a2
+        j1, j2 = PollJitter("s0", 0.1), PollJitter("s1", 0.1)
+        seq1 = [j1.next_unit() for _ in range(8)]
+        seq2 = [j2.next_unit() for _ in range(8)]
+        assert seq1 != seq2  # distinct streams de-synchronize
+
+    def test_stretch_bounds(self):
+        j = PollJitter("anything", 0.25)
+        for _ in range(64):
+            s = j.stretch()
+            assert 1.0 <= s < 1.25
+
+    def test_zero_fraction_is_identity(self):
+        j = PollJitter("s0", 0.0)
+        assert j.stretch() == 1.0
+
+    def test_lowpass_driver_exposes_poll_jitter(self, tmp_path):
+        from tpudas.proc.streaming import run_lowpass_realtime
+
+        src = tmp_path / "empty"
+        src.mkdir()
+        out = str(tmp_path / "outj")
+        sleeps = []
+        run_lowpass_realtime(
+            source=str(src),
+            output_folder=out,
+            start_time=T0,
+            output_sample_interval=1.0,
+            edge_buffer=8.0,
+            process_patch_size=40,
+            poll_interval=0.0,
+            sleep_fn=sleeps.append,
+            max_rounds=3,
+            poll_jitter=0.25,
+        )
+        # clamp floor 125 s, stretched by the folder-seeded LCG
+        from tpudas.proc.streaming import _shim_stream_id
+
+        expected = 125.0 * PollJitter(
+            _shim_stream_id(out), 0.25
+        ).stretch()
+        assert sleeps and sleeps[0] == pytest.approx(expected)
+        assert sleeps[0] > 125.0
+
+    def test_rolling_driver_exposes_poll_jitter(self, tmp_path):
+        from tpudas.proc.streaming import run_rolling_realtime
+        from tpudas.core.units import s as sec
+
+        src = str(tmp_path / "raw")
+        _feed(src, 0, 1)
+        out = str(tmp_path / "rollj")
+        sleeps = []
+        run_rolling_realtime(
+            source=src,
+            output_folder=out,
+            window=1.0 * sec,
+            step=1.0 * sec,
+            poll_interval=20.0,
+            sleep_fn=sleeps.append,
+            max_rounds=3,
+            poll_jitter=0.5,
+        )
+        from tpudas.proc.streaming import _shim_stream_id
+
+        expected = 20.0 * PollJitter(
+            _shim_stream_id(out), 0.5
+        ).stretch()
+        assert sleeps and sleeps[0] == pytest.approx(expected)
+
+
+class TestFleetByteIdentity:
+    def test_three_streams_match_single_stream_controls(self, tmp_path):
+        """The acceptance core, in-process: a fleet of 3 streams
+        (distinct content per stream, one mid-run feed) produces
+        outputs and pyramids byte-identical to 3 independent
+        single-stream driver runs over the same per-stream feed
+        schedule."""
+        root = str(tmp_path / "root")
+        noises = {"s0": 0.005, "s1": 0.01, "s2": 0.02}
+        sources = {}
+        specs = []
+        for sid, noise in noises.items():
+            src = str(tmp_path / f"src_{sid}")
+            _feed(src, 0, 2, noise=noise)
+            sources[sid] = src
+            specs.append(
+                StreamSpec(
+                    stream_id=sid, source=src,
+                    config=_lowpass_config(pyramid=True),
+                )
+            )
+        fed = {"done": False}
+
+        def fleet_sleep(_):
+            if not fed["done"]:
+                fed["done"] = True
+                for sid, src in sources.items():
+                    _feed(src, 2, 1, noise=noises[sid])
+
+        summary = FleetEngine(root, specs, sleep_fn=fleet_sleep).run()
+        assert summary["rounds_total"] == 6  # 2 rounds per stream
+        assert summary["parked"] == []
+        for sid in noises:
+            assert summary["streams"][sid]["status"] == "terminated"
+            assert summary["streams"][sid]["rounds"] == 2
+        # controls: same feed schedule, one stream at a time, via the
+        # legacy driver (identical runner code, driven alone)
+        for sid, noise in noises.items():
+            ctrl_src = str(tmp_path / f"ctrl_src_{sid}")
+            _feed(ctrl_src, 0, 2, noise=noise)
+            ctrl_out = str(tmp_path / f"ctrl_out_{sid}")
+            _run_control(
+                ctrl_src, ctrl_out,
+                feed_fn=lambda s=ctrl_src, n=noise: _feed(s, 2, 1, noise=n),
+                pyramid=True,
+            )
+            got = _output_shas(os.path.join(root, sid))
+            want = _output_shas(ctrl_out)
+            assert got == want, f"stream {sid} outputs differ"
+            assert got  # non-vacuous
+            assert _pyramid_shas(os.path.join(root, sid)) == (
+                _pyramid_shas(ctrl_out)
+            ), f"stream {sid} pyramid differs"
+        # distinct content per stream: the controls differ pairwise
+        shas = [_output_shas(os.path.join(root, sid)) for sid in noises]
+        assert shas[0] != shas[1] != shas[2]
+
+
+class TestFleetFairness:
+    def test_stalled_spool_cannot_starve_the_rest(self, tmp_path):
+        """One stream's index updates stall (an NFS-slow spool); the
+        deficit round-robin serves the healthy streams first in every
+        later scheduling window, and they complete all their rounds."""
+        root = str(tmp_path / "root")
+        specs = []
+        for sid in ("slow", "fast1", "fast2"):
+            src = str(tmp_path / f"src_{sid}")
+            _feed(src, 0, 2)
+            specs.append(
+                StreamSpec(
+                    stream_id=sid, source=src,
+                    config=_lowpass_config(poll_jitter=0.0),
+                )
+            )
+        fed = {"n": 0}
+
+        def fleet_sleep(_):
+            # two mid-run feeds -> 3 processing rounds per stream
+            if fed["n"] < 2:
+                fed["n"] += 1
+                for sid in ("slow", "fast1", "fast2"):
+                    _feed(
+                        str(tmp_path / f"src_{sid}"), 1 + fed["n"], 1
+                    )
+
+        plan = FaultPlan(
+            FaultSpec(
+                "index.update", action="delay", seconds=0.6,
+                at=1, times=50, match="src_slow",
+            )
+        )
+        eng = FleetEngine(root, specs, sleep_fn=fleet_sleep)
+        with install_fault_plan(plan):
+            summary = eng.run()
+        for sid in ("fast1", "fast2"):
+            assert summary["streams"][sid]["status"] == "terminated"
+            assert summary["streams"][sid]["rounds"] == 3
+        assert summary["streams"]["slow"]["rounds"] == 3
+        # zero jitter -> every poll window has all three streams due
+        # at once; after the slow stream's first expensive step its
+        # deficit debt puts it LAST in every later window
+        log = [sid for sid, _status, _w in eng.service_log]
+        windows = [log[i : i + 3] for i in range(0, len(log), 3)]
+        assert all(len(w) == 3 for w in windows)
+        for w in windows[1:]:
+            assert set(w) == {"slow", "fast1", "fast2"}
+            assert w[-1] == "slow", f"slow not served last: {windows}"
+        # the ledger of wall debt agrees
+        assert (
+            eng.streams["slow"].wall_seconds
+            > eng.streams["fast1"].wall_seconds
+        )
+
+    def test_fatal_stream_parks_not_the_fleet(self, tmp_path):
+        """A fatal per-stream failure parks that stream; the fleet
+        finishes the others and reports the parked one."""
+        root = str(tmp_path / "root")
+        specs = []
+        for sid in ("s0", "s1", "s2"):
+            src = str(tmp_path / f"src_{sid}")
+            _feed(src, 0, 1)
+            specs.append(
+                StreamSpec(
+                    stream_id=sid, source=src,
+                    config=_lowpass_config(poll_jitter=0.0),
+                )
+            )
+        # hit 2 of round.body = the second stream served in window 0;
+        # ValueError classifies fatal -> parked, not retried
+        plan = FaultPlan(
+            FaultSpec(
+                "round.body", exc=ValueError("bad config"), at=2
+            )
+        )
+        eng = FleetEngine(root, specs, sleep_fn=lambda _s: None)
+        with install_fault_plan(plan):
+            summary = eng.run()
+        assert summary["parked"] == ["s1"]
+        assert summary["streams"]["s1"]["status"] == "parked"
+        assert "bad config" in summary["streams"]["s1"]["error"]
+        for sid in ("s0", "s2"):
+            assert summary["streams"][sid]["status"] == "terminated"
+            assert summary["streams"][sid]["rounds"] == 1
+
+
+class TestFleetCrashResume:
+    @pytest.mark.parametrize(
+        "site,at", [("carry.save", 2), ("round.body", 5)]
+    )
+    def test_ki_mid_fleet_resumes_byte_identical(
+        self, tmp_path, site, at
+    ):
+        """KeyboardInterrupt mid-fleet (the in-process stand-in for
+        SIGKILL — tools/crash_drill.py --streams drills the real
+        signal) kills the whole engine with streams at different
+        progress points; a fresh engine over the same folders resumes
+        every stream to a state byte-identical to its uninterrupted
+        single-stream control."""
+        root = str(tmp_path / "root")
+        noises = {"s0": 0.005, "s1": 0.01, "s2": 0.02}
+        specs = []
+        for sid, noise in noises.items():
+            src = str(tmp_path / f"src_{sid}")
+            _feed(src, 0, 2, noise=noise)
+            specs.append(
+                StreamSpec(
+                    stream_id=sid,
+                    source=str(tmp_path / f"src_{sid}"),
+                    config=_lowpass_config(
+                        pyramid=True, poll_jitter=0.0
+                    ),
+                )
+            )
+        plan = FaultPlan(FaultSpec(site, exc=KeyboardInterrupt, at=at))
+        with install_fault_plan(plan):
+            with pytest.raises(KeyboardInterrupt):
+                FleetEngine(
+                    root, specs, sleep_fn=lambda _s: None
+                ).run()
+        # restart over the same folders: per-stream startup audit +
+        # carry resume do the recovery
+        summary = FleetEngine(
+            root, specs, sleep_fn=lambda _s: None
+        ).run()
+        assert summary["parked"] == []
+        for sid, noise in noises.items():
+            ctrl_src = str(tmp_path / f"ctrl_src_{sid}")
+            _feed(ctrl_src, 0, 2, noise=noise)
+            ctrl_out = str(tmp_path / f"ctrl_out_{sid}")
+            _run_control(ctrl_src, ctrl_out, pyramid=True)
+            assert _output_shas(os.path.join(root, sid)) == (
+                _output_shas(ctrl_out)
+            ), f"stream {sid} outputs differ after crash-resume"
+            assert _pyramid_shas(os.path.join(root, sid)) == (
+                _pyramid_shas(ctrl_out)
+            ), f"stream {sid} pyramid differs after crash-resume"
+
+
+class TestAuditFleet:
+    def test_classify_repair_across_stream_roots(self, tmp_path):
+        from tpudas.integrity.audit import audit_fleet, fleet_stream_dirs
+
+        root = str(tmp_path / "root")
+        for sid in ("a", "b"):
+            src = str(tmp_path / f"src_{sid}")
+            _feed(src, 0, 1)
+            _run_control(src, os.path.join(root, sid))
+        # fleet bookkeeping dot-dirs are not streams
+        os.makedirs(os.path.join(root, ".xla_cache"))
+        assert [s for s, _p in fleet_stream_dirs(root)] == ["a", "b"]
+        # damage stream a's carry primary (torn; .prev survives) and
+        # drop a crashed writer's tmp into stream b
+        from tpudas.proc.stream import CARRY_FILENAME
+
+        carry = os.path.join(root, "a", CARRY_FILENAME)
+        with open(carry, "r+b") as fh:
+            fh.write(b"\x00garbage\x00")
+        with open(os.path.join(root, "b", "junk.tmp"), "wb") as fh:
+            fh.write(b"half a write")
+        report = audit_fleet(root, repair=True)
+        assert set(report["streams"]) == {"a", "b"}
+        assert report["clean"] is True  # everything repaired
+        assert report["issues_total"] >= 2
+        assert report["repaired_total"] >= 2
+        arts = {
+            it["artifact"] for it in report["streams"]["a"]["issues"]
+        }
+        assert "carry" in arts
+        assert any(
+            it["status"] == "stale_tmp"
+            for it in report["streams"]["b"]["issues"]
+        )
+        # idempotence: a second audit finds nothing
+        again = audit_fleet(root, repair=True)
+        assert again["clean"] and again["issues_total"] == 0
+        # the repaired carry still resumes its stream
+        from tpudas.proc.stream import load_carry
+
+        assert load_carry(os.path.join(root, "a")) is not None
+
+    def test_fsck_cli_fleet_flag(self, tmp_path):
+        root = str(tmp_path / "root")
+        src = str(tmp_path / "src")
+        _feed(src, 0, 1)
+        _run_control(src, os.path.join(root, "only"))
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "tools", "fsck.py"),
+                root, "--fleet",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=240,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        rep = json.loads(proc.stdout)
+        assert rep["clean"] is True
+        assert set(rep["streams"]) == {"only"}
+
+
+class TestFleetServer:
+    def test_routes_and_fleet_healthz(self, tmp_path):
+        from tpudas.serve.http import DASServer
+        from tpudas.serve.query import QueryEngine
+
+        root = str(tmp_path / "root")
+        specs = []
+        for sid in ("s0", "s1"):
+            src = str(tmp_path / f"src_{sid}")
+            _feed(src, 0, 2)
+            specs.append(
+                StreamSpec(
+                    stream_id=sid, source=src,
+                    config=_lowpass_config(pyramid=True, health=True),
+                )
+            )
+        FleetEngine(root, specs, sleep_fn=lambda _s: None).run()
+        t0 = "2023-03-22T00:00:10"
+        t1 = "2023-03-22T00:00:40"
+        with DASServer.for_fleet(root) as srv:
+            u = srv.base_url
+            # per-stream query == the offline engine over that folder
+            r = urllib.request.urlopen(
+                f"{u}/s/s0/query?t0={t0}&t1={t1}", timeout=30
+            )
+            assert r.status == 200
+            import io as _io
+
+            got = np.load(_io.BytesIO(r.read()))
+            ref = QueryEngine(os.path.join(root, "s0")).query(t0, t1)
+            np.testing.assert_array_equal(got, ref.data)
+            assert got.size > 0
+            # per-stream healthz reads that stream's snapshot
+            h = json.loads(
+                urllib.request.urlopen(
+                    f"{u}/s/s1/healthz", timeout=30
+                ).read()
+            )
+            assert h["status"] in ("ok", "degraded")
+            assert h["rounds"] == 1
+            # the aggregate view covers every mounted stream
+            fh = json.loads(
+                urllib.request.urlopen(
+                    f"{u}/fleet/healthz", timeout=30
+                ).read()
+            )
+            assert set(fh["streams"]) == {"s0", "s1"}
+            assert fh["counts"]["ok"] + fh["counts"]["degraded"] == 2
+            assert fh["status"] in ("ok", "degraded")
+            # unknown stream -> 404 naming the known ones
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"{u}/s/nope/query?t0={t0}&t1={t1}", timeout=30
+                )
+            assert err.value.code == 404
+            body = json.loads(err.value.read())
+            assert body["streams"] == ["s0", "s1"]
+            # fleet-only server: bare data endpoints point at /s/...
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"{u}/query?t0={t0}&t1={t1}", timeout=30
+                )
+            assert err.value.code == 404
+            # merged /metrics stays process-wide (control plane)
+            text = urllib.request.urlopen(
+                f"{u}/metrics", timeout=30
+            ).read().decode()
+            assert "tpudas_serve_requests_total" in text
+
+    def test_single_folder_server_unchanged(self, tmp_path):
+        """The pre-fleet surface: DASServer(folder) still serves the
+        bare endpoints (regression guard for the mount refactor)."""
+        from tpudas.serve.http import DASServer
+
+        src = str(tmp_path / "src")
+        _feed(src, 0, 1)
+        out = str(tmp_path / "out")
+        _run_control(src, out, pyramid=True)
+        with DASServer(out) as srv:
+            r = urllib.request.urlopen(
+                srv.base_url
+                + "/query?t0=2023-03-22T00:00:10&t1=2023-03-22T00:00:20",
+                timeout=30,
+            )
+            assert r.status == 200
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    srv.base_url + "/fleet/healthz", timeout=30
+                )
+            assert err.value.code == 503  # no streams mounted
+
+    def test_server_requires_some_mount(self):
+        from tpudas.serve.http import DASServer
+
+        with pytest.raises(ValueError, match="folder, streams"):
+            DASServer()
+
+
+class TestFleetDrillSmoke:
+    @pytest.mark.slow
+    def test_fleet_crash_drill_small(self, tmp_path):
+        """Subprocess SIGKILL smoke of the fleet drill (2 streams, 2
+        cycles); the full --streams 4 acceptance run is recorded in
+        BENCH_pr08.json by tools/fleet_bench.py."""
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import crash_drill
+
+        rep = crash_drill.run_fleet_drill(
+            engine="cascade", streams=2, cycles=2, seed=0,
+            workdir=str(tmp_path),
+        )
+        assert rep["ok"], rep
+        assert rep["audit_clean"]
+        assert all(
+            s["ok"] for s in rep["streams_match"].values()
+        )

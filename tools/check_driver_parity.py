@@ -1,0 +1,151 @@
+"""Driver-parity lint: the legacy realtime drivers and the fleet
+round engine accept the same ``StreamConfig`` fields.
+
+``run_lowpass_realtime`` / ``run_rolling_realtime`` are thin shims
+over :class:`tpudas.fleet.StreamConfig` + the runners (ISSUE 8).  A
+shim stays compatible only while the three surfaces agree, so this
+lint asserts, by introspection:
+
+1. every :class:`StreamConfig` field is claimed by exactly the field
+   sets (``COMMON_FIELDS`` + ``LOWPASS_ONLY_FIELDS`` +
+   ``ROLLING_ONLY_FIELDS``) — no orphan fields, no phantom names;
+2. each driver's signature = its kind's config fields + the declared
+   run-control parameters (``source`` / ``output_folder`` /
+   ``max_rounds`` / ``sleep_fn`` / ...), nothing more, nothing less —
+   a config kwarg added to a driver but not to ``StreamConfig`` (or
+   vice versa) fails here, so the shim cannot drift;
+3. both runner classes construct from a ``StreamConfig`` of their
+   kind (the constructors consume config by attribute, so a field
+   rename breaks loudly at build time — checked with a minimal spec).
+
+Run from anywhere:
+
+    python tools/check_driver_parity.py
+
+Exit code 0 = clean; 1 = violations (printed one per line).  Wired
+into tier-1 via tests/test_fleet.py.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def lint() -> list:
+    """Returns a list of violation strings (empty = clean)."""
+    from dataclasses import fields
+
+    from tpudas.fleet.config import (
+        COMMON_FIELDS,
+        LOWPASS_FIELDS,
+        LOWPASS_ONLY_FIELDS,
+        ROLLING_FIELDS,
+        ROLLING_ONLY_FIELDS,
+        RUN_CONTROL_PARAMS,
+        StreamConfig,
+    )
+    from tpudas.proc.streaming import (
+        run_lowpass_realtime,
+        run_rolling_realtime,
+    )
+
+    problems = []
+
+    # 1. field sets exactly partition the dataclass (minus `kind`)
+    declared = (
+        set(COMMON_FIELDS) | set(LOWPASS_ONLY_FIELDS)
+        | set(ROLLING_ONLY_FIELDS)
+    )
+    actual = {f.name for f in fields(StreamConfig)} - {"kind"}
+    for name in sorted(actual - declared):
+        problems.append(
+            f"StreamConfig field {name!r} is not claimed by any of "
+            "COMMON/LOWPASS_ONLY/ROLLING_ONLY_FIELDS"
+        )
+    for name in sorted(declared - actual):
+        problems.append(
+            f"declared field {name!r} does not exist on StreamConfig"
+        )
+    overlap = (
+        (set(LOWPASS_ONLY_FIELDS) & set(ROLLING_ONLY_FIELDS))
+        | (set(COMMON_FIELDS) & set(LOWPASS_ONLY_FIELDS))
+        | (set(COMMON_FIELDS) & set(ROLLING_ONLY_FIELDS))
+    )
+    for name in sorted(overlap):
+        problems.append(
+            f"field {name!r} appears in more than one field set"
+        )
+
+    # 2. driver signature == kind fields + run-control, exactly
+    for fn, kind_fields, kind in (
+        (run_lowpass_realtime, LOWPASS_FIELDS, "lowpass"),
+        (run_rolling_realtime, ROLLING_FIELDS, "rolling"),
+    ):
+        params = set(inspect.signature(fn).parameters)
+        config_params = params - RUN_CONTROL_PARAMS
+        for name in sorted(config_params - set(kind_fields)):
+            problems.append(
+                f"{fn.__name__} kwarg {name!r} is not a {kind} "
+                "StreamConfig field (add it to tpudas/fleet/config.py "
+                "or declare it in RUN_CONTROL_PARAMS)"
+            )
+        for name in sorted(set(kind_fields) - config_params):
+            problems.append(
+                f"{kind} StreamConfig field {name!r} is missing from "
+                f"the {fn.__name__} signature (the legacy shim must "
+                "accept every config field of its kind)"
+            )
+
+    # 3. the runners construct from a minimal config of their kind —
+    # the constructors consume config by attribute, so a field rename
+    # that slipped past 1-2 (sets and signatures updated consistently)
+    # still breaks loudly here
+    import tempfile
+
+    try:
+        from tpudas.fleet.config import StreamSpec
+        from tpudas.fleet.engine import build_runner
+
+        lp = StreamConfig(
+            kind="lowpass",
+            start_time="2023-01-01",
+            output_sample_interval=1.0,
+            edge_buffer=4.0,
+            process_patch_size=16,
+        )
+        rl = StreamConfig(kind="rolling", window=1.0, step=1.0)
+        with tempfile.TemporaryDirectory(
+            prefix="parity_lint_"
+        ) as root:
+            for cfg in (lp, rl):
+                build_runner(
+                    StreamSpec(
+                        stream_id="lint", source=root, config=cfg
+                    ),
+                    root=root,
+                )
+    except Exception as exc:
+        problems.append(
+            "runner/config construction check failed: "
+            f"{type(exc).__name__}: {exc}"
+        )
+    return problems
+
+
+def main(argv=None) -> int:
+    problems = lint()
+    for p in problems:
+        print(p)
+    if not problems:
+        print("check_driver_parity: OK (drivers and StreamConfig agree)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

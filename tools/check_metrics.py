@@ -85,6 +85,15 @@ REQUIRED_METRICS = (
     # sweep reads these by name to prove the device-resident carry
     "tpudas_parallel_shards",
     "tpudas_parallel_transfer_bytes_total",
+    # fleet round engine (PR 8): tools/fleet_bench.py and the FLEET.md
+    # runbook read these by name
+    "tpudas_fleet_streams",
+    "tpudas_fleet_streams_active",
+    "tpudas_fleet_streams_parked",
+    "tpudas_fleet_parked_total",
+    "tpudas_fleet_steps_total",
+    "tpudas_fleet_step_seconds",
+    "tpudas_fleet_sched_seconds_total",
 )
 REQUIRED_SPANS = (
     "serve.request",
@@ -96,6 +105,8 @@ REQUIRED_SPANS = (
     "serve.events",
     "parallel.place",
     "parallel.gather",
+    "fleet.run",
+    "fleet.step",
 )
 
 

@@ -58,8 +58,21 @@ while the control replay stays single-device: one run then proves
 both that SIGKILL cycles on the SHARDED path end audit-clean and that
 the sharded path is byte-identical to the unsharded engines.
 
+``--streams N`` (ISSUE 8) drills the FLEET: every cycle spawns one
+process running a :class:`tpudas.fleet.FleetEngine` over N streams
+(identical per-epoch feeds into N separate source spools, per-stream
+state under ``out/<stream_id>/``), SIGKILLs it mid-interleave, and at
+the end asserts ``tpudas.integrity.audit.audit_fleet`` is clean and
+EVERY stream's merged outputs, pyramid tree, and detect state are
+byte-identical to a SINGLE-STREAM control replay of the same epoch
+schedule — the fleet scheduler may interleave N carries, quarantines,
+and pyramids through one process and one SIGKILL, but each stream
+must crash-resume exactly as if it ran alone.  (``--streams`` and
+``--mesh`` are mutually exclusive.)
+
 ``tests/test_integrity.py`` runs a small seeded smoke in tier-1 and
-the full drill under ``-m slow``.
+the full drill under ``-m slow``; ``tests/test_fleet.py`` smokes the
+fleet drill.
 """
 
 from __future__ import annotations
@@ -127,6 +140,46 @@ def _worker(src: str, out: str, engine: str) -> int:
     return 0
 
 
+def _fleet_worker(src_root: str, out: str, engine: str,
+                  n_streams: int) -> int:
+    """The fleet drill's subprocess: one FleetEngine over N streams,
+    same per-stream config as :func:`_worker` (so each stream's
+    single-stream control is the plain worker)."""
+    import time as _t
+
+    from tpudas.fleet import FleetEngine, StreamConfig, StreamSpec
+
+    os.makedirs(out, exist_ok=True)
+    config = StreamConfig(
+        kind="lowpass",
+        start_time=T0,
+        output_sample_interval=DT_OUT,
+        edge_buffer=EDGE_SEC,
+        process_patch_size=PATCH_OUT,
+        poll_interval=0.0,
+        engine=engine,
+        pyramid=True,
+        health=True,
+        detect=True,
+        detect_operators=DETECT_OPS,
+    )
+    specs = [
+        StreamSpec(
+            stream_id=f"s{i:02d}",
+            source=os.path.join(src_root, f"s{i:02d}"),
+            config=config,
+        )
+        for i in range(int(n_streams))
+    ]
+    with open(out + ".ready", "w") as fh:
+        fh.write(str(os.getpid()))
+    FleetEngine(
+        out, specs, max_rounds=8,
+        sleep_fn=lambda _s: _t.sleep(0.01),
+    ).run()
+    return 0
+
+
 # ---------------------------------------------------------------------------
 # the parent harness
 
@@ -152,12 +205,14 @@ def _rm_ready(out: str) -> None:
 
 
 def _run_cycle(src, out, engine, kill_after, log_fh=None,
-               mesh=0) -> dict:
+               mesh=0, streams=0) -> dict:
     """One worker subprocess; ``kill_after`` seconds after READY send
     SIGKILL (None = let it finish).  ``mesh`` > 0 runs the worker
     channel-sharded over that many CPU-virtualized devices
     (``TPUDAS_MESH`` + ``--xla_force_host_platform_device_count``) —
-    the driver resolves the env var itself.  Returns {killed, wall}."""
+    the driver resolves the env var itself.  ``streams`` > 0 runs the
+    FLEET worker (``src`` is then the source root holding one spool
+    per stream).  Returns {killed, wall}."""
     _rm_ready(out)
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -178,11 +233,13 @@ def _run_cycle(src, out, engine, kill_after, log_fh=None,
         "TPUDAS_COMPILE_CACHE",
         os.path.join(os.path.dirname(out), "xla_cache"),
     )
+    argv = (
+        ["--fleet-worker", src, out, engine, str(int(streams))]
+        if streams
+        else ["--worker", src, out, engine]
+    )
     proc = subprocess.Popen(
-        [
-            sys.executable, os.path.abspath(__file__),
-            "--worker", src, out, engine,
-        ],
+        [sys.executable, os.path.abspath(__file__), *argv],
         env=env,
         stdout=log_fh if log_fh is not None else subprocess.DEVNULL,
         stderr=subprocess.STDOUT if log_fh is not None else (
@@ -425,6 +482,124 @@ def run_drill(
             log_fh.close()
 
 
+def run_fleet_drill(
+    engine: str = "cascade",
+    streams: int = 4,
+    cycles: int = 12,
+    seed: int = 0,
+    workdir: str | None = None,
+    files_init: int = 2,
+    files_per_cycle: int = 1,
+    log_path: str | None = None,
+) -> dict:
+    """The fleet drill (ISSUE 8): SIGKILL a ``streams``-wide
+    :class:`tpudas.fleet.FleetEngine` mid-interleave for ``cycles``
+    seeded cycles, then prove ``audit_fleet`` is clean and EVERY
+    stream's post-crash state is byte-identical to a single-stream
+    control replay of the same epoch schedule.
+
+    Every stream is fed the SAME synthetic files each epoch (separate
+    source spools, identical bytes), so ONE single-stream control
+    covers all N comparisons; epoch gating holds the feed until a
+    cycle runs uninterrupted, exactly as :func:`run_drill` does (and
+    for the same chunk-schedule reason)."""
+    import numpy as np
+
+    from tpudas.integrity.audit import audit_fleet
+
+    streams = int(streams)
+    workdir = workdir or tempfile.mkdtemp(
+        prefix=f"crash_drill_fleet{streams}_{engine}_"
+    )
+    src_root = os.path.join(workdir, "src")
+    out = os.path.join(workdir, "out")
+    ctrl = os.path.join(workdir, "ctrl")
+    log_fh = open(log_path, "ab") if log_path else None
+    sids = [f"s{i:02d}" for i in range(streams)]
+
+    def feed_all(first, count):
+        for sid in sids:
+            _feed(os.path.join(src_root, sid), first, count)
+
+    try:
+        epochs = [(0, files_init)]
+        feed_all(0, files_init)
+        cold = _run_cycle(src_root, out, engine, None, log_fh,
+                          streams=streams)
+        epochs.append((files_init, files_per_cycle))
+        feed_all(files_init, files_per_cycle)
+        warm = _run_cycle(src_root, out, engine, None, log_fh,
+                          streams=streams)
+        est = max(warm["wall"], 0.2)
+        rng = np.random.default_rng(seed)
+        n_files = files_init + files_per_cycle
+        kills = 0
+        cycle_log = []
+        advance = True
+        for _c in range(int(cycles)):
+            if advance:
+                epochs.append((n_files, files_per_cycle))
+                feed_all(n_files, files_per_cycle)
+                n_files += files_per_cycle
+            kill_after = float(rng.uniform(0.02, est * 0.95))
+            r = _run_cycle(src_root, out, engine, kill_after, log_fh,
+                           streams=streams)
+            kills += int(r["killed"])
+            advance = not r["killed"]
+            if not r["killed"]:
+                est = max(0.5 * est + 0.5 * r["wall"], 0.2)
+            cycle_log.append({"kill_after": round(kill_after, 3), **r})
+        # drain, then the whole fleet root must audit clean
+        _run_cycle(src_root, out, engine, None, log_fh, streams=streams)
+        report = audit_fleet(out, repair=True)
+        # ONE single-stream control (identical feeds): the plain
+        # worker over the same epoch schedule
+        ctrl_src = os.path.join(workdir, "ctrl_src")
+        for first, count in epochs:
+            _feed(ctrl_src, first, count)
+            _run_cycle(ctrl_src, ctrl, engine, None, log_fh)
+        ctrl_hash = _content_hash(ctrl)
+        ctrl_pyr = _pyramid_tree(ctrl)
+        ctrl_det = _detect_state(ctrl)
+        detect_events = 0
+        if ctrl_det.get("ledger_sha"):
+            from tpudas.detect.ledger import load_events
+
+            detect_events = len(load_events(ctrl))
+        per_stream = {}
+        all_match = True
+        for sid in sids:
+            sdir = os.path.join(out, sid)
+            entry = {
+                "outputs_match": _content_hash(sdir) == ctrl_hash,
+                "pyramid_match": _pyramid_tree(sdir) == ctrl_pyr,
+                "detect_match": _detect_state(sdir) == ctrl_det,
+            }
+            entry["ok"] = all(entry.values())
+            all_match = all_match and entry["ok"]
+            per_stream[sid] = entry
+        return {
+            "engine": engine,
+            "streams": streams,
+            "cycles": int(cycles),
+            "seed": int(seed),
+            "kills": kills,
+            "epochs": len(epochs),
+            "cold_wall_s": cold["wall"],
+            "warm_wall_s": warm["wall"],
+            "audit_clean": bool(report["clean"]),
+            "audit_issues": report["issues_total"],
+            "streams_match": per_stream,
+            "detect_events": int(detect_events),
+            "cycle_log": cycle_log,
+            "workdir": workdir,
+            "ok": bool(report["clean"] and all_match),
+        }
+    finally:
+        if log_fh is not None:
+            log_fh.close()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--cycles", type=int, default=25)
@@ -440,10 +615,39 @@ def main(argv=None) -> int:
         help="channel-shard the DRILLED cycles over N CPU-virtualized "
         "devices (the control replay stays single-device)",
     )
+    ap.add_argument(
+        "--streams", type=int, default=0,
+        help="drill a FLEET of N streams in one process per cycle "
+        "(each stream compared to a single-stream control replay); "
+        "mutually exclusive with --mesh",
+    )
     args = ap.parse_args(argv)
+    if args.streams and args.mesh:
+        ap.error("--streams and --mesh are mutually exclusive")
     results = {}
     ok = True
     for engine in [e for e in args.engines.split(",") if e]:
+        if args.streams:
+            print(
+                f"crash_drill: engine={engine} cycles={args.cycles} "
+                f"seed={args.seed} streams={args.streams}"
+            )
+            rep = run_fleet_drill(
+                engine=engine, streams=args.streams,
+                cycles=args.cycles, seed=args.seed, log_path=args.log,
+            )
+            results[engine] = rep
+            ok = ok and rep["ok"]
+            matched = sum(
+                 1 for s in rep["streams_match"].values() if s["ok"]
+            )
+            print(
+                f"crash_drill: {engine}: kills={rep['kills']} "
+                f"audit_clean={rep['audit_clean']} "
+                f"streams_match={matched}/{rep['streams']} "
+                f"(events={rep['detect_events']})"
+            )
+            continue
         print(f"crash_drill: engine={engine} cycles={args.cycles} "
               f"seed={args.seed} mesh={args.mesh}")
         rep = run_drill(
@@ -461,7 +665,8 @@ def main(argv=None) -> int:
             f"(events={rep['detect_events']})"
         )
     payload = {"cycles": args.cycles, "seed": args.seed,
-               "mesh": args.mesh, "ok": ok, "engines": results}
+               "mesh": args.mesh, "streams": args.streams, "ok": ok,
+               "engines": results}
     if args.out:
         with open(args.out, "w") as fh:
             json.dump(payload, fh, indent=1)
@@ -472,4 +677,10 @@ def main(argv=None) -> int:
 if __name__ == "__main__":
     if len(sys.argv) >= 5 and sys.argv[1] == "--worker":
         sys.exit(_worker(sys.argv[2], sys.argv[3], sys.argv[4]))
+    if len(sys.argv) >= 6 and sys.argv[1] == "--fleet-worker":
+        sys.exit(
+            _fleet_worker(
+                sys.argv[2], sys.argv[3], sys.argv[4], int(sys.argv[5])
+            )
+        )
     sys.exit(main())

@@ -1,0 +1,409 @@
+"""Fleet scale bench (ISSUE 8): N concurrent streams on one host.
+
+Four measurements, written to ``BENCH_pr08.json``:
+
+1. **Scale sweep** — N ∈ {1, 2, 4, 8} same-config streams at
+   1 kHz × 256 channels, each N in a FRESH subprocess (cold jit, so
+   compile sharing is measured honestly per run).  Per N: aggregate
+   real-time factor (total stream-seconds processed / run wall),
+   per-stream head-lag spread, per-stream FIRST processing-round wall
+   (the compile-sharing evidence: stream 1 pays the jit, streams 2..N
+   warm-start from the in-process cache — ≤ 1 compile per kernel,
+   counted directly off jax's monitoring events), and scheduler
+   overhead (deficit-round-robin bookkeeping wall / total step wall,
+   acceptance < 2%).
+2. **Byte identity** — a fleet of 4 same-config streams (pyramid +
+   detect on, identical per-stream feeds) versus ONE single-stream
+   driver control: outputs, parsed stream carry, pyramid tree, and
+   events ledger must be byte-identical per stream (the acceptance
+   criterion, in-process form).
+3. **Fleet crash drill** — ``tools/crash_drill.py`` ``--streams 4``:
+   seeded SIGKILL cycles against the fleet worker, every stream
+   audit-clean and byte-identical to its single-stream control.
+4. The headline gauges read back from the metrics registry
+   (``tpudas_fleet_*`` — OBSERVABILITY.md).
+
+Run (CPU):
+
+    JAX_PLATFORMS=cpu python tools/fleet_bench.py --out BENCH_pr08.json
+
+Knobs: ``--streams 1,2,4,8``  ``--fs 1000``  ``--channels 256``
+``--file-sec 10``  ``--drill-cycles 6`` (0 skips the drill).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+T0 = "2023-03-22T00:00:00"
+DT_OUT = 1.0
+EDGE_SEC = 5.0
+PATCH_OUT = 20
+
+
+def _feed(directory, start_index, count, fs, n_ch, file_sec,
+          noise=0.01):
+    import numpy as np
+
+    from tpudas.testing import make_synthetic_spool
+
+    make_synthetic_spool(
+        directory, n_files=count, file_duration=file_sec, fs=fs,
+        n_ch=n_ch, noise=noise,
+        start=np.datetime64(T0)
+        + np.timedelta64(int(start_index * file_sec * 1e9), "ns"),
+        prefix=f"raw{start_index:04d}",
+    )
+
+
+def _install_compile_counter():
+    """Count backend compiles via jax's monitoring events (any event
+    whose name mentions compilation).  Private-API tolerant: on drift
+    the bench falls back to the first-round-wall evidence."""
+    counts: dict = {}
+    try:
+        from jax._src import monitoring
+
+        def _on_event(event, **kw):
+            if "compil" in event:
+                counts[event] = counts.get(event, 0) + 1
+
+        def _on_duration(event, duration, **kw):
+            if "compil" in event:
+                counts[event] = counts.get(event, 0) + 1
+
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:
+        pass
+    return counts
+
+
+def run_scale_child(n_streams, fs, n_ch, file_sec, feeds=2) -> dict:
+    """One fresh-process scale point: an N-stream fleet, 2 files
+    upfront + ``feeds`` mid-run feeds per stream."""
+    from tpudas.fleet import FleetEngine, StreamConfig, StreamSpec
+
+    compile_counts = _install_compile_counter()
+    workdir = tempfile.mkdtemp(prefix=f"fleet_bench_{n_streams}_")
+    root = os.path.join(workdir, "root")
+    config = StreamConfig(
+        kind="lowpass",
+        start_time=T0,
+        output_sample_interval=DT_OUT,
+        edge_buffer=EDGE_SEC,
+        process_patch_size=PATCH_OUT,
+        poll_interval=0.0,
+    )
+    specs = []
+    sources = []
+    for i in range(n_streams):
+        src = os.path.join(workdir, f"src{i:02d}")
+        _feed(src, 0, 2, fs, n_ch, file_sec)
+        sources.append(src)
+        specs.append(
+            StreamSpec(
+                stream_id=f"s{i:02d}", source=src, config=config
+            )
+        )
+    fed = {"n": 0}
+
+    def feeder(_wait):
+        if fed["n"] < feeds:
+            fed["n"] += 1
+            for src in sources:
+                _feed(src, 1 + fed["n"], 1, fs, n_ch, file_sec)
+
+    eng = FleetEngine(root, specs, sleep_fn=feeder)
+    t0 = time.perf_counter()
+    summary = eng.run()
+    wall = time.perf_counter() - t0
+    files_total = 2 + feeds
+    data_sec_per_stream = files_total * file_sec
+    # first PROCESSING step wall per stream, in service order — the
+    # compile-sharing evidence (stream 1 cold, the rest warm)
+    first_walls = {}
+    for sid, status, w in eng.service_log:
+        if status == "processed" and sid not in first_walls:
+            first_walls[sid] = round(w, 4)
+    step_wall = sum(w for _sid, _st, w in eng.service_log)
+    lags = [
+        s["head_lag_seconds"]
+        for s in summary["streams"].values()
+        if s["head_lag_seconds"] is not None
+    ]
+    return {
+        "streams": n_streams,
+        "fs_hz": fs,
+        "channels": n_ch,
+        "data_seconds_per_stream": data_sec_per_stream,
+        "rounds_total": summary["rounds_total"],
+        "wall_seconds": round(wall, 3),
+        "aggregate_realtime_factor": round(
+            n_streams * data_sec_per_stream / wall, 2
+        ),
+        "per_stream_realtime_factor": {
+            sid: s["realtime_factor"]
+            for sid, s in summary["streams"].items()
+        },
+        "head_lag_seconds": {
+            "min": round(min(lags), 3) if lags else None,
+            "max": round(max(lags), 3) if lags else None,
+            "spread": round(max(lags) - min(lags), 3) if lags else None,
+        },
+        "first_round_wall_seconds": first_walls,
+        "compile_share": _compile_share(first_walls),
+        "compile_events": compile_counts,
+        "sched_seconds": summary["sched_seconds"],
+        "sched_overhead_pct": round(
+            100.0 * summary["sched_seconds"] / step_wall, 4
+        )
+        if step_wall
+        else 0.0,
+        "parked": summary["parked"],
+    }
+
+
+def _compile_share(first_walls: dict) -> dict:
+    """Cold-vs-warm first-round evidence: the first-served stream pays
+    the jit compile, later same-shape streams reuse it."""
+    walls = list(first_walls.values())
+    if len(walls) < 2:
+        return {"cold_s": walls[0] if walls else None, "warm_max_s": None,
+                "shared": None}
+    cold, rest = walls[0], walls[1:]
+    return {
+        "cold_s": round(cold, 4),
+        "warm_max_s": round(max(rest), 4),
+        "shared": bool(max(rest) < 0.5 * cold),
+    }
+
+
+def bench_byte_identity(streams=4, fs=200.0, n_ch=16,
+                        file_sec=20.0) -> dict:
+    """The acceptance criterion, in-process: a fleet of N same-config
+    streams (pyramid + detect + health on, identical feeds) versus
+    ONE single-stream driver control — outputs, parsed carry, pyramid
+    tree, and events ledger byte-identical per stream."""
+    import hashlib
+
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from crash_drill import DETECT_OPS, _pyramid_tree
+
+    from tpudas.fleet import FleetEngine, StreamConfig, StreamSpec
+    from tpudas.proc.streaming import run_lowpass_realtime
+
+    workdir = tempfile.mkdtemp(prefix="fleet_bench_ident_")
+    root = os.path.join(workdir, "root")
+    config = StreamConfig(
+        kind="lowpass",
+        start_time=T0,
+        output_sample_interval=DT_OUT,
+        edge_buffer=EDGE_SEC,
+        process_patch_size=PATCH_OUT,
+        poll_interval=0.0,
+        pyramid=True,
+        detect=True,
+        detect_operators=DETECT_OPS,
+        health=True,
+    )
+    specs = []
+    for i in range(streams):
+        src = os.path.join(workdir, f"src{i:02d}")
+        _feed(src, 0, 2, fs, n_ch, file_sec)
+        specs.append(
+            StreamSpec(stream_id=f"s{i:02d}", source=src,
+                       config=config)
+        )
+    sources = [s.source for s in specs]
+    fed = {"done": False}
+
+    def feeder(_wait):
+        if not fed["done"]:
+            fed["done"] = True
+            for src in sources:
+                _feed(src, 2, 1, fs, n_ch, file_sec)
+
+    FleetEngine(root, specs, sleep_fn=feeder).run()
+    # one control (identical feeds): the legacy single-stream driver
+    ctrl_src = os.path.join(workdir, "ctrl_src")
+    ctrl = os.path.join(workdir, "ctrl")
+    _feed(ctrl_src, 0, 2, fs, n_ch, file_sec)
+    state = {"done": False}
+
+    def ctrl_sleep(_):
+        if not state["done"]:
+            state["done"] = True
+            _feed(ctrl_src, 2, 1, fs, n_ch, file_sec)
+
+    run_lowpass_realtime(
+        source=ctrl_src, output_folder=ctrl, start_time=T0,
+        output_sample_interval=DT_OUT, edge_buffer=EDGE_SEC,
+        process_patch_size=PATCH_OUT, poll_interval=0.0,
+        sleep_fn=ctrl_sleep, pyramid=True, detect=True,
+        detect_operators=DETECT_OPS, health=True,
+    )
+
+    def output_shas(folder):
+        out = {}
+        for name in sorted(os.listdir(folder)):
+            if name.startswith("LFDAS_") and name.endswith(".h5"):
+                with open(os.path.join(folder, name), "rb") as fh:
+                    out[name] = hashlib.sha256(fh.read()).hexdigest()
+        return out
+
+    def carry_digest(folder):
+        from tpudas.proc.stream import load_carry
+
+        c = load_carry(folder)
+        if c is None:
+            return None
+        h = hashlib.sha256()
+        h.update(json.dumps(c._meta(), sort_keys=True).encode())
+        return h.hexdigest()
+
+    def ledger_sha(folder):
+        path = os.path.join(folder, ".detect", "events.jsonl")
+        if not os.path.isfile(path):
+            return None
+        with open(path, "rb") as fh:
+            return hashlib.sha256(fh.read()).hexdigest()
+
+    want = (
+        output_shas(ctrl), carry_digest(ctrl), _pyramid_tree(ctrl),
+        ledger_sha(ctrl),
+    )
+    per_stream = {}
+    for spec in specs:
+        sdir = os.path.join(root, spec.stream_id)
+        got = (
+            output_shas(sdir), carry_digest(sdir), _pyramid_tree(sdir),
+            ledger_sha(sdir),
+        )
+        per_stream[spec.stream_id] = {
+            "outputs_match": got[0] == want[0],
+            "carry_match": got[1] == want[1] and got[1] is not None,
+            "pyramid_match": got[2] == want[2],
+            "events_match": got[3] == want[3] and got[3] is not None,
+        }
+        per_stream[spec.stream_id]["ok"] = all(
+            per_stream[spec.stream_id].values()
+        )
+    return {
+        "streams": streams,
+        "per_stream": per_stream,
+        "ok": all(s["ok"] for s in per_stream.values()),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--streams", default="1,2,4,8")
+    ap.add_argument("--fs", type=float, default=1000.0)
+    ap.add_argument("--channels", type=int, default=256)
+    ap.add_argument("--file-sec", type=float, default=10.0)
+    ap.add_argument("--drill-cycles", type=int, default=6)
+    ap.add_argument("--drill-streams", type=int, default=4)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--child", type=int, default=0,
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args.child:
+        rep = run_scale_child(
+            args.child, args.fs, args.channels, args.file_sec
+        )
+        print("FLEET_CHILD_JSON:" + json.dumps(rep))
+        return 0
+
+    payload: dict = {
+        "bench": "fleet",
+        "fs_hz": args.fs,
+        "channels": args.channels,
+        "scale": [],
+    }
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("TPUDAS_COMPILE_CACHE", None)  # cold per child, honestly
+    for n in [int(x) for x in args.streams.split(",") if x]:
+        print(f"fleet_bench: scale N={n} ...")
+        proc = subprocess.run(
+            [
+                sys.executable, os.path.abspath(__file__),
+                "--child", str(n),
+                "--fs", str(args.fs),
+                "--channels", str(args.channels),
+                "--file-sec", str(args.file_sec),
+            ],
+            env=env, capture_output=True, text=True, timeout=3600,
+        )
+        if proc.returncode != 0:
+            print(proc.stdout + proc.stderr)
+            raise RuntimeError(f"scale child N={n} failed")
+        line = [
+            ln for ln in proc.stdout.splitlines()
+            if ln.startswith("FLEET_CHILD_JSON:")
+        ][-1]
+        rep = json.loads(line.split(":", 1)[1])
+        payload["scale"].append(rep)
+        print(
+            f"fleet_bench: N={n} aggregate_rt="
+            f"{rep['aggregate_realtime_factor']} "
+            f"sched_overhead={rep['sched_overhead_pct']}% "
+            f"compile_share={rep['compile_share']}"
+        )
+
+    print("fleet_bench: byte identity (fleet of 4 vs single control)")
+    payload["byte_identity"] = bench_byte_identity()
+    print(f"fleet_bench: byte_identity ok={payload['byte_identity']['ok']}")
+
+    if args.drill_cycles > 0:
+        print(
+            f"fleet_bench: crash drill --streams {args.drill_streams} "
+            f"({args.drill_cycles} cycles)"
+        )
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from crash_drill import run_fleet_drill
+
+        drill = run_fleet_drill(
+            engine="cascade", streams=args.drill_streams,
+            cycles=args.drill_cycles, seed=0,
+        )
+        drill.pop("cycle_log", None)
+        payload["crash_drill_streams"] = drill
+        print(
+            f"fleet_bench: drill kills={drill['kills']} "
+            f"audit_clean={drill['audit_clean']} ok={drill['ok']}"
+        )
+
+    sched_ok = all(
+        s["sched_overhead_pct"] < 2.0 for s in payload["scale"]
+    )
+    payload["ok"] = bool(
+        sched_ok
+        and payload["byte_identity"]["ok"]
+        and payload.get("crash_drill_streams", {}).get("ok", True)
+    )
+    text = json.dumps(payload, indent=1)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(text + "\n")
+    print(text)
+    print(f"fleet_bench: {'OK' if payload['ok'] else 'FAILED'}")
+    return 0 if payload["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,6 +13,9 @@ promote ``.prev``, remove, rebuild the pyramid from the outputs).
 Options:
     --no-repair     report only; change nothing on disk
     --no-rebuild    repair everything except pyramid rebuilds
+    --fleet         treat the folder as a fleet root: audit every
+                    <root>/<stream_id>/ independently and aggregate
+                    (tpudas.integrity.audit.audit_fleet, FLEET.md)
     --out PATH      also write the JSON report to PATH
 
 Run only while the driver is stopped: the stale-tmp sweep cannot tell
@@ -45,12 +48,16 @@ def main(argv=None) -> int:
         "--no-rebuild", action="store_true",
         help="repair everything except pyramid rebuilds",
     )
+    ap.add_argument(
+        "--fleet", action="store_true",
+        help="audit every <folder>/<stream_id>/ as a fleet root",
+    )
     ap.add_argument("--out", default=None, help="write JSON report here")
     args = ap.parse_args(argv)
 
-    from tpudas.integrity.audit import audit
+    from tpudas.integrity.audit import audit, audit_fleet
 
-    report = audit(
+    report = (audit_fleet if args.fleet else audit)(
         args.folder,
         repair=not args.no_repair,
         rebuild=not args.no_rebuild,

@@ -1,0 +1,42 @@
+"""tpudas.fleet — the multi-array round engine (ISSUE 8).
+
+One edge host, N concurrent interrogator streams: the two realtime
+drivers' duplicated round loops, hoisted into a reusable round engine
+(:mod:`tpudas.fleet.engine`) and scheduled concurrently
+(:mod:`tpudas.fleet.fleet`) with per-stream state under
+``root/<stream_id>/``, deficit-round-robin fairness, per-stream fault
+parking, deterministic poll jitter, and one shared compile cache.
+Served by one HTTP plane (:mod:`tpudas.serve` — ``/s/<stream_id>/...``
+routes plus aggregate ``/fleet/healthz``), audited per stream root by
+:func:`tpudas.integrity.audit.audit_fleet`, and SIGKILL-drilled by
+``tools/crash_drill.py --streams N``.  See FLEET.md.
+"""
+
+from tpudas.fleet.config import (  # noqa: F401
+    StreamConfig,
+    StreamSpec,
+)
+from tpudas.fleet.engine import (  # noqa: F401
+    LowpassStreamRunner,
+    PollJitter,
+    RollingStreamRunner,
+    StepResult,
+    StreamRunner,
+    build_runner,
+    drive,
+)
+from tpudas.fleet.fleet import FleetEngine, run_fleet  # noqa: F401
+
+__all__ = [
+    "FleetEngine",
+    "LowpassStreamRunner",
+    "PollJitter",
+    "RollingStreamRunner",
+    "StepResult",
+    "StreamConfig",
+    "StreamRunner",
+    "StreamSpec",
+    "build_runner",
+    "drive",
+    "run_fleet",
+]

@@ -1,0 +1,1187 @@
+"""The reusable round engine: one stream's polling loop as an object.
+
+Before the fleet (ISSUE 8), ``run_lowpass_realtime`` and
+``run_rolling_realtime`` each owned a private ``while True`` — two
+near-identical copies of poll / process-what's-new / handle-faults /
+sleep.  This module hoists that loop body into *runners*
+(:class:`LowpassStreamRunner`, :class:`RollingStreamRunner`): one
+:meth:`StreamRunner.step` call is exactly one poll attempt of the old
+loop — index update, processing round, serve/detect hooks, fault
+boundary — and returns a :class:`StepResult` saying what happened and
+how long to wait before the next poll.  Crucially ``step`` never
+sleeps: WHO waits (a single-stream driver's ``sleep_fn``, or the fleet
+scheduler interleaving N streams) is the caller's business, which is
+what makes N concurrent streams in one process possible at all.
+
+:func:`drive` is the single-stream driver loop rebuilt over ``step`` —
+``run_lowpass_realtime`` / ``run_rolling_realtime`` are now thin shims
+(``StreamConfig`` + runner + ``drive``) with byte-identical behavior;
+:class:`tpudas.fleet.fleet.FleetEngine` schedules many runners.
+
+Per-stream poll jitter (:class:`PollJitter`): a deterministic LCG
+seeded by the stream id stretches each poll interval by up to
+``poll_jitter`` (fraction, default 0 / ``TPUDAS_POLL_JITTER``), so N
+co-located streams de-synchronize their spool scans instead of
+thundering-herding the filesystem on a shared cadence.  Deterministic
+by the same argument as ``RetryPolicy.delay``: tests and post-mortems
+can predict every wait.
+
+Everything here preserves the drivers' crash-only contract: a runner
+holds no durable state of its own — kill the process (or just drop the
+runner) anywhere and a new runner over the same folders resumes
+exactly where the carry/ledger/pyramid say.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import time as _time
+import zlib
+from dataclasses import dataclass
+
+import numpy as np
+
+from tpudas.core.timeutils import to_datetime64, to_timedelta64
+from tpudas.fleet.config import StreamSpec
+from tpudas.io.spool import spool as make_spool
+from tpudas.obs.health import write_health, write_prom
+from tpudas.obs.registry import get_registry
+from tpudas.obs.trace import span
+from tpudas.proc.lfproc import LFProc
+from tpudas.proc.naming import get_filename
+from tpudas.resilience.faults import (
+    FaultBoundary,
+    RetryPolicy,
+    fault_point,
+)
+from tpudas.resilience.quarantine import QuarantineLedger
+from tpudas.utils.logging import log_event
+from tpudas.utils.profiling import Counters
+
+__all__ = [
+    "POLL_FLOOR_SEC",
+    "LowpassStreamRunner",
+    "PollJitter",
+    "RollingStreamRunner",
+    "StepResult",
+    "StreamRunner",
+    "build_runner",
+    "clamp_poll_interval",
+    "drive",
+]
+
+
+@dataclass
+class StepResult:
+    """What one :meth:`StreamRunner.step` did.
+
+    ``status`` is one of:
+
+    - ``"processed"`` — a round completed and emitted/advanced output;
+    - ``"empty"`` — the poll saw nothing new (first no-growth poll);
+    - ``"terminate"`` — the spool stopped growing: the stream is done
+      (reference semantics — the caller must call
+      :meth:`StreamRunner.finish` for the clean-termination flush);
+    - ``"retry"`` — the round failed, the fault boundary scheduled a
+      retry: wait ``delay`` (the boundary's capped backoff), then call
+      ``step`` again.  ``kind``/``attempt`` feed the ``stream.retry``
+      span.
+
+    ``delay`` is the advisory wait before the next ``step`` (the
+    jittered poll interval, or the retry backoff)."""
+
+    status: str
+    delay: float = 0.0
+    kind: str = ""
+    attempt: int = 0
+
+
+class PollJitter:
+    """Deterministic per-stream poll jitter: a tiny LCG seeded by the
+    stream id.  ``stretch()`` returns a factor in
+    ``[1, 1 + fraction)``, advancing the LCG once per call — the same
+    no-RNG-state, no-wall-clock discipline as
+    :meth:`tpudas.resilience.faults.RetryPolicy.delay`."""
+
+    def __init__(self, stream_id, fraction: float):
+        self.fraction = max(float(fraction or 0.0), 0.0)
+        # crc32 folds any id into a stable 32-bit seed; " or 1" keeps
+        # the LCG out of the zero fixed point for ids that hash to 0
+        self._state = zlib.crc32(str(stream_id).encode()) & 0x7FFFFFFF or 1
+
+    def next_unit(self) -> float:
+        """The next LCG draw in [0, 1)."""
+        self._state = (1103515245 * self._state + 12345) % (1 << 31)
+        return self._state / float(1 << 31)
+
+    def stretch(self) -> float:
+        if not self.fraction:
+            return 1.0
+        return 1.0 + self.fraction * self.next_unit()
+
+
+def resolve_poll_jitter(poll_jitter) -> float:
+    """``poll_jitter`` fraction: the explicit value, else
+    ``TPUDAS_POLL_JITTER``, else 0 (single-stream drivers keep their
+    exact pre-fleet cadence unless asked)."""
+    if poll_jitter is None:
+        raw = os.environ.get("TPUDAS_POLL_JITTER", "")
+        poll_jitter = float(raw) if raw else 0.0
+    return max(float(poll_jitter), 0.0)
+
+
+class _EdgeHealth:
+    """Per-run health bookkeeping for the realtime driver: assembles
+    the ``health.json`` payload (schema: tpudas.obs.health) and drops
+    it — plus the Prometheus exposition — beside the stream carry
+    every round.  Enabled by ``TPUDAS_HEALTH=1`` (or the driver's
+    ``health=True``); write failures are counted and swallowed.
+
+    Integrity fields (schema v3): ``integrity_fallbacks`` is the
+    per-run count of verified reads that rejected a primary artifact
+    and took a degradation-ladder step; ``resource_degraded`` mirrors
+    the disk-full shedding flag.  Either condition marks the snapshot
+    ``degraded`` — recovery happened (or writers are shed), the
+    operator should know.  Under resource pressure ``metrics.prom`` is
+    shed (counted) while ``health.json`` itself keeps being written:
+    it is the operator's only window into the degradation."""
+
+    def __init__(self, folder, enabled, boundary=None):
+        from tpudas.integrity.checksum import fallback_count
+
+        self.folder = folder
+        self.enabled = enabled
+        self.boundary = boundary  # FaultBoundary (degradation fields)
+        self.carry_resumes = 0
+        self.last_error = None
+        # optional detect summary (tpudas.detect) — surfaced in the
+        # snapshot (and through /healthz) as a "detect" sub-object;
+        # not part of the required schema, absent when detect is off
+        self.detect = None
+        self._fb0 = fallback_count()  # run baseline for the delta
+
+    def integrity_fallbacks(self) -> int:
+        from tpudas.integrity.checksum import fallback_count
+
+        return fallback_count() - self._fb0
+
+    def write(self, counters, rounds, polls, mode, round_rt, head_lag):
+        if not self.enabled:
+            return
+        from tpudas.integrity import resource as _resource
+
+        b = self.boundary
+        fallbacks = self.integrity_fallbacks()
+        res_degraded = _resource.is_degraded()
+        degraded = (
+            (False if b is None else b.degraded)
+            or res_degraded
+            or fallbacks > 0
+        )
+        payload_extra = (
+            {} if self.detect is None else {"detect": self.detect}
+        )
+        write_health(
+            self.folder,
+            {
+                **payload_extra,
+                "rounds": rounds,
+                "polls": polls,
+                "mode": mode,
+                "realtime_factor": round(counters.realtime_factor, 3),
+                "round_realtime_factor": round(round_rt, 3),
+                "head_lag_seconds": (
+                    None if head_lag is None else round(head_lag, 3)
+                ),
+                "redundant_ratio": round(counters.redundant_ratio, 4),
+                "carry_resume_count": self.carry_resumes,
+                "last_round_wall_seconds": round(counters.last_wall, 4),
+                "consecutive_failures": 0 if b is None else b.consecutive,
+                "quarantined_files": (
+                    0 if b is None else b.quarantined_count
+                ),
+                "degraded": degraded,
+                "integrity_fallbacks": fallbacks,
+                "resource_degraded": res_degraded,
+                "last_error": self.last_error
+                or (None if b is None else b.last_error),
+            },
+        )
+        if not _resource.should_shed("prom"):
+            write_prom(self.folder)
+
+
+def _startup_audit(output_folder) -> None:
+    """The drivers' pre-first-round fsck (tpudas.integrity.audit):
+    sweep stale tmp files, verify every durable artifact, repair via
+    the .prev/rebuild ladder.  Disable with
+    ``TPUDAS_INTEGRITY_AUDIT=0``.  Never raises — an audit failure
+    must not take down the stream it protects (counted + logged)."""
+    if os.environ.get("TPUDAS_INTEGRITY_AUDIT", "1") == "0":
+        return
+    try:
+        from tpudas.integrity.audit import audit
+
+        report = audit(output_folder, repair=True)
+        if report["issues"]:
+            print(
+                f"Integrity audit repaired {report['repaired']} "
+                f"artifact(s) in {output_folder} "
+                f"(clean={report['clean']})"
+            )
+    except Exception as exc:
+        get_registry().counter(
+            "tpudas_integrity_audit_errors_total",
+            "startup integrity audits that raised (swallowed)",
+        ).inc()
+        log_event(
+            "integrity_audit_failed",
+            folder=str(output_folder),
+            error=f"{type(exc).__name__}: {str(exc)[:200]}",
+        )
+
+
+def _append_pyramid(output_folder, rnd, emitted, state) -> None:
+    """Per-round serve-side hook: cascade this round's new output rows
+    into the :mod:`tpudas.serve.tiles` pyramid beside the carry.
+
+    ``emitted`` holds the round's output patches captured in memory at
+    their write site (an ``LFProc.add_emit_listener`` subscription),
+    so the steady-state append costs tile IO only — no index rescan,
+    no re-reading files this process just wrote.  ``state["store"]`` carries the open store
+    across rounds (a stat-gated refresh per round, not a re-parse);
+    it is dropped to None on any failure — exactly the carry's
+    crash-equivalent discipline — and any discontinuity (fresh
+    folder, crashed append) falls back to the file-backed sync, so a
+    retried or crash-resumed round needs no pyramid bookkeeping: disk
+    is the only durable state.  A pyramid failure is counted and
+    swallowed: the read side degrades (the query engine falls back to
+    full-resolution files), the write side must not."""
+    from tpudas.serve.tiles import CorruptStoreError, append_patches
+
+    reg = get_registry()
+    t0 = _time.perf_counter()
+    try:
+        with span("serve.pyramid_append", round=rnd):
+            appended, state["store"] = append_patches(
+                output_folder, emitted, store=state.get("store")
+            )
+    except Exception as exc:
+        state["store"] = None  # crash-equivalent: re-resolve from disk
+        reg.counter(
+            "tpudas_serve_pyramid_errors_total",
+            "per-round pyramid appends that failed (swallowed; the "
+            "query engine falls back to full-resolution files)",
+        ).inc()
+        log_event(
+            "pyramid_append_failed",
+            round=rnd,
+            error=f"{type(exc).__name__}: {str(exc)[:200]}",
+        )
+        from tpudas.integrity import resource as _resource
+
+        if _resource.is_resource_error(exc):
+            # disk full: flip the shedding flag so the NEXT rounds
+            # skip the append instead of re-failing it
+            _resource.note_pressure("pyramid", exc)
+        elif isinstance(exc, CorruptStoreError):
+            # the store itself is bad (torn tails, checksum-failed
+            # tile): the ladder's last rung — delete + rebuild from
+            # the output files, byte-identical, mid-run
+            from tpudas.serve.tiles import rebuild_pyramid
+
+            try:
+                rebuild_pyramid(output_folder)
+            except Exception as exc2:
+                log_event(
+                    "pyramid_rebuild_failed",
+                    round=rnd,
+                    error=f"{type(exc2).__name__}: {str(exc2)[:200]}",
+                )
+        return
+    reg.histogram(
+        "tpudas_serve_pyramid_append_seconds",
+        "per-round tile-pyramid append wall time",
+    ).observe(_time.perf_counter() - t0)
+    if appended:
+        log_event("pyramid_append", round=rnd, rows=int(appended))
+
+
+def _head_lag_seconds(t2, lfp, carry) -> float | None:
+    """Stream-seconds between the fiber head (newest indexed input,
+    ``t2``) and the newest emitted output — the operator's "how far
+    behind live am I" number.  None before the first output."""
+    t_out_ns = None
+    if carry is not None and carry.last_emit_ns is not None:
+        t_out_ns = int(carry.last_emit_ns)
+    else:
+        try:
+            t_out_ns = int(
+                to_datetime64(lfp.get_last_processed_time())
+                .astype("datetime64[ns]")
+                .astype(np.int64)
+            )
+        except Exception:
+            return None
+    t2_ns = int(
+        np.datetime64(t2, "ns").astype(np.int64)
+    )
+    return (t2_ns - t_out_ns) / 1e9
+
+
+def _finite(value) -> float:
+    """Coerce an index cell to a finite float (0.0 for None/NaN/junk) —
+    a heterogeneous or legacy index row must degrade the metric, never
+    crash the processing loop."""
+    try:
+        v = float(value)
+    except (TypeError, ValueError):
+        return 0.0
+    return v if math.isfinite(v) else 0.0
+
+
+def _covered_workload(contents, t1, t2):
+    """(data_seconds, channel_samples) actually present in the index
+    within [t1, t2) — gaps and heterogeneous files are accounted per
+    file, so round metrics stay honest across outages and rewinds."""
+    lo = to_datetime64(t1).astype("datetime64[ns]")
+    hi = to_datetime64(t2).astype("datetime64[ns]")
+    data_ns = 0.0
+    samples = 0.0
+    for _, row in contents.iterrows():
+        f_lo = np.datetime64(row["time_min"], "ns")
+        f_hi = np.datetime64(row["time_max"], "ns")
+        span_ns = (f_hi - f_lo) / np.timedelta64(1, "ns")
+        ov = min(hi, f_hi) - max(lo, f_lo)
+        ov_ns = ov / np.timedelta64(1, "ns")
+        if ov_ns <= 0:
+            continue
+        data_ns += ov_ns
+        n_time = _finite(row.get("ntime"))
+        if span_ns > 0 and n_time > 1:
+            fs = (n_time - 1) / (span_ns / 1e9)
+            samples += ov_ns / 1e9 * fs * _finite(row.get("ndistance"))
+    return data_ns / 1e9, samples
+
+
+POLL_FLOOR_SEC = 125.0
+
+
+def clamp_poll_interval(requested, file_duration, edge_buffer):
+    """The reference's cadence guard
+    (low_pass_dascore_edge.ipynb:165-173): the poll interval is
+    ``max(125 s, file duration, 3 * edge buffer)`` — and never faster
+    than requested. The absolute 125 s floor is unconditional; it
+    bounds the chance of reading a file the interrogator is still
+    mid-writing (the only race surface in the crash-only design).
+    Tests inject ``sleep_fn`` rather than lowering the clamp."""
+    return max(
+        float(requested),
+        POLL_FLOOR_SEC,
+        float(file_duration),
+        3.0 * float(edge_buffer),
+    )
+
+
+# ---------------------------------------------------------------------------
+# the runners
+
+
+class StreamRunner:
+    """Base: identity, jitter, and the step bookkeeping every kind
+    shares.  Subclasses implement :meth:`step`; :meth:`finish` /
+    :meth:`record_fatal` are the clean-termination flush and the
+    terminal-failure snapshot (no-ops where a kind has neither)."""
+
+    kind = "?"
+
+    def __init__(self, spec: StreamSpec, output_folder: str):
+        self.spec = spec
+        self.stream_id = str(spec.stream_id)
+        self.source = spec.source
+        self.output_folder = str(output_folder)
+        self.rounds = 0
+        self.polls = 0
+        self.jitter = PollJitter(
+            self.stream_id,
+            resolve_poll_jitter(spec.config.poll_jitter),
+        )
+        self.interval = 0.0  # subclasses set the clamped poll cadence
+
+    def poll_delay(self) -> float:
+        """The advisory wait before the next poll: the clamped
+        interval stretched by this stream's deterministic jitter."""
+        return self.interval * self.jitter.stretch()
+
+    def step(self) -> StepResult:
+        raise NotImplementedError
+
+    def finish(self) -> None:
+        """Clean-termination flush (never called on a crash path — a
+        mid-increment carry may be ahead of the written outputs)."""
+
+    def record_fatal(self, exc: BaseException) -> None:
+        """The terminal-failure snapshot, called by the driver/fleet
+        just before the exception propagates (or parks the stream)."""
+
+
+class LowpassStreamRunner(StreamRunner):
+    """One low-pass (optionally joint-rolling) stream: the hoisted
+    ``run_lowpass_realtime`` round loop.  See that shim's docstring
+    for every knob's semantics — behavior is identical by
+    construction (the shim IS this runner plus :func:`drive`)."""
+
+    kind = "lowpass"
+
+    def __init__(
+        self,
+        spec: StreamSpec,
+        output_folder: str,
+        counters: Counters | None = None,
+        on_round=None,
+    ):
+        super().__init__(spec, output_folder)
+        cfg = spec.config
+        if cfg.kind != "lowpass":
+            raise ValueError(
+                f"LowpassStreamRunner needs kind='lowpass', got "
+                f"{cfg.kind!r}"
+            )
+        self.on_round = on_round
+        self.d_t = float(cfg.output_sample_interval)
+        self.edge_buffer = float(cfg.edge_buffer)
+        self.buff_out = int(np.ceil(self.edge_buffer / self.d_t))
+        self.process_patch_size = int(cfg.process_patch_size)
+        self.interval = clamp_poll_interval(
+            125.0 if cfg.poll_interval is None else cfg.poll_interval,
+            0.0 if cfg.file_duration is None else cfg.file_duration,
+            self.edge_buffer,
+        )
+        self.start_time = to_datetime64(cfg.start_time)
+        self.distance = cfg.distance
+        self.rolling_output_folder = cfg.rolling_output_folder
+        self.rolling_window = cfg.rolling_window
+        self.rolling_step = cfg.rolling_step
+        self.extra = {
+            k: v
+            for k, v in (
+                ("engine", cfg.engine),
+                ("on_gap", cfg.on_gap),
+                ("filter_order", cfg.filter_order),
+                ("data_gap_tolerance", cfg.data_gap_tolerance),
+                ("window_dp", cfg.window_dp),
+            )
+            if v is not None
+        }
+        from tpudas.parallel.mesh import resolve_mesh
+
+        self.mesh = resolve_mesh(cfg.mesh)
+        self.counters = counters if counters is not None else Counters()
+        health = cfg.health
+        if health is None:
+            health = os.environ.get("TPUDAS_HEALTH", "0") == "1"
+        policy = (
+            cfg.fault_policy if cfg.fault_policy is not None
+            else RetryPolicy()
+        )
+        # carry/ledger/health/pyramid all live in the output folder; it
+        # must exist before the first processing round creates it
+        os.makedirs(self.output_folder, exist_ok=True)
+        # startup fsck BEFORE any persisted state (ledger, carry,
+        # pyramid) is loaded: stale tmp sweep, checksum verification,
+        # .prev promotion, pyramid rebuild — see tpudas.integrity.audit
+        _startup_audit(self.output_folder)
+        from tpudas.integrity import resource as _resource
+
+        if _resource.is_degraded():
+            # stale in-process pressure from a previous run: re-probe
+            _resource.probe_recovery(self.output_folder)
+        ledger = (
+            QuarantineLedger(self.output_folder) if cfg.quarantine
+            else None
+        )
+        self.boundary = FaultBoundary(policy, ledger)
+        self.edge_health = _EdgeHealth(
+            self.output_folder, bool(health), self.boundary
+        )
+        pyramid = cfg.pyramid
+        if pyramid is None:
+            pyramid = os.environ.get("TPUDAS_PYRAMID", "0") == "1"
+        self.pyramid = bool(pyramid)
+        detect = cfg.detect
+        if detect is None:
+            detect = os.environ.get("TPUDAS_DETECT", "0") == "1"
+        self.detect = bool(detect)
+        self.detect_operators = cfg.detect_operators
+
+        stateful = cfg.stateful
+        if stateful is None:
+            stateful = os.environ.get(
+                "TPUDAS_STREAM_STATEFUL", "1"
+            ) != "0"
+        # a channel-only mesh keeps the stateful path (the carry shards
+        # over it, device-resident); a time-sharded mesh falls back to
+        # the window/rewind path, which owns the halo exchange
+        self.stateful = bool(stateful) and (
+            self.rolling_output_folder is None
+            and not cfg.window_dp
+            and (
+                self.mesh is None
+                or int(self.mesh.shape.get("time", 1)) <= 1
+            )
+        )
+        carry_save_every = cfg.carry_save_every
+        if carry_save_every is None:
+            carry_save_every = int(
+                os.environ.get("TPUDAS_CARRY_SAVE_EVERY", "") or 1
+            )
+        self.carry_save_every = max(1, int(carry_save_every))
+        self.carry = None  # the cross-round filter state (stateful)
+        self.carry_unsaved = 0  # rounds since the last carry save
+        self.carry_checked = False  # disk/legacy resolution, once
+        self.rewind_wrote = False  # first rewind write kills any carry
+        self.pyr_state = {"store": None}  # cross-round open tile store
+        self.det_state = {"pipe": None}  # cross-round detect pipeline
+
+        self.processed_once = False  # first PROCESSING round always
+        # starts at start_time, however many empty polls precede it (a
+        # pre-existing output folder must not hijack the user's start)
+        self.prev_t2 = None  # previous round's head (redundancy metric)
+        self.len_last = None  # spool size at the previous poll
+        self.round_rt = 0.0  # last round's realtime factor
+        self.head_lag = None
+
+    # -- one poll attempt ----------------------------------------------
+    def step(self) -> StepResult:
+        reg = get_registry()
+        self.polls += 1
+        reg.counter(
+            "tpudas_stream_polls_total", "source spool polls"
+        ).inc()
+        from tpudas.integrity import resource as _resource
+
+        try:
+            fault_point("round.body", poll=self.polls)
+            # quarantine exclusion + index update + scan-failure
+            # strikes + slow-schedule probe bookkeeping
+            sp = self.boundary.begin_round(
+                make_spool(self.source), self.source
+            )
+            sub = (
+                sp.select(distance=self.distance)
+                if self.distance is not None
+                else sp
+            )
+            n_now = len(sub)
+            if (
+                self.len_last is not None
+                and n_now == self.len_last
+                and self.boundary.consecutive == 0
+            ):
+                print("No new data was detected. Real-time processing ended successfully.")
+                return StepResult("terminate")
+            status = "empty"
+            if n_now > 0:
+                status = "processed"
+                self._process_round(sub, reg)
+            else:
+                self.boundary.on_success()
+            if _resource.is_degraded():
+                # disk-full recovery probe: one tiny write — the
+                # moment it succeeds, shed writers resume and the
+                # pyramid backfills from the output files
+                _resource.probe_recovery(self.output_folder)
+            # every poll (including an empty first one) sets the
+            # growth baseline: the next no-growth poll terminates
+            # (reference semantics — the loop ends when the spool
+            # stops growing, low_pass_dascore_edge.ipynb:205-207)
+            self.len_last = n_now
+        except Exception as exc:
+            decision = self.boundary.on_failure(exc)
+            if decision.propagate:
+                raise
+            # crash-equivalent retry: drop the in-memory carry and
+            # re-resolve it from disk on the next attempt — the
+            # resume path reconciles any partial outputs exactly as
+            # a process restart would, so a retried round and a
+            # crash-restart are the same code path
+            if self.stateful:
+                self.carry = None
+                self.carry_checked = False
+                self.carry_unsaved = 0
+            self.pyr_state["store"] = None
+            self.det_state["pipe"] = None
+            self.edge_health.write(
+                self.counters, self.rounds, self.polls,
+                self._mode(), 0.0, None,
+            )
+            return StepResult(
+                "retry", decision.delay, decision.kind,
+                self.boundary.consecutive,
+            )
+        return StepResult(status, self.poll_delay())
+
+    def _mode(self) -> str:
+        return "stateful" if self.stateful else "rewind"
+
+    def _process_round(self, sub, reg) -> None:
+        from tpudas.integrity import resource as _resource
+
+        t_body = _time.perf_counter()
+        joint_extra = {}
+        if self.rolling_output_folder is not None:
+            from tpudas.proc.joint import JointProc
+
+            lfp = JointProc(sub, mesh=self.mesh)
+            joint_extra = {
+                k: v
+                for k, v in (
+                    ("rolling_window", self.rolling_window),
+                    ("rolling_step", self.rolling_step),
+                )
+                if v is not None
+            }
+        else:
+            lfp = LFProc(sub, mesh=self.mesh)
+        lfp.update_processing_parameter(
+            output_sample_interval=self.d_t,
+            process_patch_size=self.process_patch_size,
+            edge_buff_size=self.buff_out,
+            **self.extra,
+            **joint_extra,
+        )
+        lfp.set_output_folder(self.output_folder, delete_existing=False)
+        emitted_patches = []
+        if self.pyramid or self.detect:
+            # capture the round's output blocks at their write site for
+            # the in-memory pyramid append and the detect operators
+            # (multi-subscriber emit hook — one capture serves both)
+            lfp.add_emit_listener(emitted_patches.append)
+        if self.rolling_output_folder is not None:
+            lfp.set_rolling_output_folder(
+                self.rolling_output_folder, delete_existing=False
+            )
+        # committed to `rounds` only when the attempt completes — a
+        # failed attempt is a retry, not a processed round
+        rnd = self.rounds + 1
+        print("run number: ", rnd)
+        if self.stateful and not self.carry_checked:
+            self._resolve_carry(lfp, reg)
+        # newest timestamp from the index — no file data is read
+        contents = sub.get_contents()
+        t2 = np.datetime64(contents["time_max"].max())
+        redundant = 0.0
+        if self.stateful:
+            # carried state: only NEW samples are read/filtered
+            t1 = (
+                np.datetime64(int(self.carry.next_ingest_ns), "ns")
+                if self.carry.next_ingest_ns is not None
+                else self.start_time
+            )
+            data_sec, ch_samples = _covered_workload(contents, t1, t2)
+            with span(
+                "stream.round", mode="stateful", round=rnd
+            ), self.counters.measure(int(ch_samples), data_sec):
+                lfp.process_stream_increment(self.carry, t2)
+            from tpudas.proc.stream import save_carry
+
+            # saved AFTER the outputs: the carry is never ahead of the
+            # files (crash-only; resume reconciles the rest).  On a >1
+            # cadence the skipped rounds keep the pytree on-device — a
+            # crash simply resumes from the last save and regenerates
+            # the tail byte-identically.
+            self.carry_unsaved += 1
+            if self.carry_unsaved >= self.carry_save_every:
+                save_carry(self.carry, self.output_folder)
+                self.carry_unsaved = 0
+        else:
+            resumed_stateful = False
+            if not self.rewind_wrote:
+                # a persisted carry means the folder head was written
+                # by the stateful mode; this rewind write breaks the
+                # carry's no-newer-outputs invariant, so invalidate it
+                # — and CONTINUE from the folder head (the t_last
+                # resume below) rather than reprocessing from
+                # start_time, leaving every stateful-era product file
+                # untouched
+                self.rewind_wrote = True
+                from tpudas.proc.stream import discard_carry
+
+                if discard_carry(self.output_folder):
+                    resumed_stateful = True
+                    print(
+                        "Removed stale stream carry; rewind "
+                        "mode continues from the folder head"
+                    )
+            if not self.processed_once and not resumed_stateful:
+                t1 = self.start_time
+            else:
+                try:
+                    t_last = lfp.get_last_processed_time()
+                except IndexError:
+                    # a prior round completed without emitting output
+                    # (stream still shorter than the edge trim) — no
+                    # checkpoint yet, retry from the very start
+                    t_last = None
+                if t_last is None:
+                    t1 = self.start_time
+                else:
+                    # rewind (ceil(edge/dt) - 1) output steps, exactly
+                    # on the output grid — ns precision so fractional
+                    # d_t stays seam-free (the resumed run's first
+                    # emitted sample is then t_last + d_t)
+                    rewind_sec = (
+                        math.ceil(self.edge_buffer / self.d_t) - 1
+                    ) * self.d_t
+                    t1 = t_last - to_timedelta64(rewind_sec)
+            data_sec, ch_samples = _covered_workload(contents, t1, t2)
+            if self.prev_t2 is not None and t1 < self.prev_t2:
+                # full-rate samples re-read solely to rebuild the
+                # filter's transient state (what stateful eliminates)
+                _, redundant = _covered_workload(
+                    contents, t1, min(self.prev_t2, t2)
+                )
+                self.counters.add_redundant(int(redundant))
+            with span(
+                "stream.round", mode="rewind", round=rnd
+            ), self.counters.measure(int(ch_samples), data_sec):
+                lfp.process_time_range(t1, t2)
+        self.prev_t2 = t2
+        self.rounds = rnd
+        self.round_rt = (
+            data_sec / self.counters.last_wall
+            if self.counters.last_wall
+            else 0.0
+        )
+        mode_str = self._mode()
+        log_event(
+            "realtime_round",
+            round=rnd,
+            upto=str(t2),
+            mode=mode_str,
+            data_seconds=round(data_sec, 3),
+            redundant_samples=int(redundant),
+            wall_seconds=round(self.counters.last_wall, 4),
+            realtime_factor=round(self.round_rt, 2),
+            engine=lfp.parameters["engine"],
+            engine_counts=dict(lfp.engine_counts),
+            native_windows=lfp.native_windows,
+        )
+        reg.counter(
+            "tpudas_stream_rounds_total",
+            "processing rounds completed",
+            labelnames=("mode",),
+        ).inc(mode=mode_str)
+        reg.histogram(
+            "tpudas_stream_round_seconds",
+            "per-round measured processing wall time",
+        ).observe(self.counters.last_wall)
+        reg.gauge(
+            "tpudas_stream_realtime_factor",
+            "last round's data-seconds per wall-second",
+        ).set(self.round_rt)
+        reg.gauge(
+            "tpudas_stream_redundant_ratio",
+            "cumulative fraction of channel-samples re-read to "
+            "rebuild filter state",
+        ).set(self.counters.redundant_ratio)
+        # stateful head lag is O(1) off the carry; the rewind fallback
+        # rescans the output index, so only pay it when an operator is
+        # actually scraping health
+        self.head_lag = (
+            _head_lag_seconds(
+                t2, lfp, self.carry if self.stateful else None
+            )
+            if (self.stateful or self.edge_health.enabled)
+            else None
+        )
+        if self.head_lag is not None:
+            reg.gauge(
+                "tpudas_stream_head_lag_seconds",
+                "stream-seconds between the fiber head and the "
+                "newest emitted output",
+            ).set(self.head_lag)
+        if self.pyramid and not _resource.should_shed("pyramid"):
+            _append_pyramid(
+                self.output_folder, rnd, emitted_patches,
+                self.pyr_state,
+            )
+        if self.detect:
+            from tpudas.detect.runner import (
+                mark_detect_shed,
+                run_detect_round,
+            )
+
+            if _resource.should_shed("detect"):
+                mark_detect_shed(self.det_state)
+            else:
+                run_detect_round(
+                    self.output_folder, rnd, emitted_patches,
+                    self.det_state, operators=self.detect_operators,
+                    step_sec=self.d_t,
+                )
+            self.edge_health.detect = self.det_state.get("summary")
+        self.boundary.on_success()
+        self.edge_health.write(
+            self.counters, rnd, self.polls, mode_str, self.round_rt,
+            self.head_lag,
+        )
+        reg.histogram(
+            "tpudas_stream_round_body_seconds",
+            "full processing-round wall time (index update "
+            "through health write, pyramid append included)",
+        ).observe(_time.perf_counter() - t_body)
+        if self.on_round is not None:
+            self.on_round(rnd, lfp)
+        self.processed_once = True
+
+    def _resolve_carry(self, lfp, reg) -> None:
+        """One-time disk resolution: resume a persisted carry, or fall
+        back to rewind mode for a legacy folder that has outputs but
+        no carry (its resume point is only expressible as a rewind)."""
+        self.carry_checked = True
+        from tpudas.proc.stream import (
+            carry_matches,
+            load_carry,
+            reconcile_outputs,
+        )
+
+        carry = load_carry(self.output_folder)
+        if carry is not None and not carry_matches(
+            carry, lfp, self.start_time
+        ):
+            raise ValueError(
+                "persisted stream carry in "
+                f"{self.output_folder} was produced under a "
+                "different start_time or processing "
+                "parameters; delete it (or the folder) to "
+                "change configuration"
+            )
+        if carry is not None:
+            # patch_size only shapes chunking — honor the live setting
+            # rather than the persisted one
+            carry.patch_out = self.process_patch_size
+            reconcile_outputs(self.output_folder, carry)
+            log_event("stream_resume", emitted=carry.emitted)
+            self.edge_health.carry_resumes += 1
+            reg.counter(
+                "tpudas_stream_carry_resumes_total",
+                "rounds resumed from a persisted stream "
+                "carry",
+            ).inc()
+            self.carry = carry
+        else:
+            try:
+                lfp.get_last_processed_time()
+                has_outputs = True
+            except (FileNotFoundError, IndexError) as exc:
+                # the two EXPECTED "no outputs yet" signals
+                # (virgin/empty folder); a real IO error must not be
+                # misread as "no outputs" — it propagates to the fault
+                # boundary instead
+                has_outputs = False
+                log_event(
+                    "stream_no_prior_outputs",
+                    reason=(
+                        f"{type(exc).__name__}: "
+                        f"{str(exc)[:120]}"
+                    ),
+                )
+            if has_outputs:
+                self.stateful = False
+                print(
+                    "Existing output folder has no stream "
+                    "carry; continuing in rewind mode"
+                )
+                log_event("stream_legacy_rewind")
+            else:
+                self.carry = lfp.open_stream(self.start_time)
+                # persist BEFORE the first outputs: a crash mid-round-1
+                # then still reads as a stateful folder (reconcile +
+                # resume) instead of degrading to rewind mode forever
+                # via the legacy heuristic above
+                from tpudas.proc.stream import save_carry
+
+                save_carry(self.carry, self.output_folder)
+
+    # -- terminal paths -------------------------------------------------
+    def finish(self) -> None:
+        # clean termination: flush a deferred carry save (cadence > 1)
+        # so the next process resumes from the true head instead of
+        # replaying the last few rounds — crash paths skip this on
+        # purpose (a mid-increment carry may be ahead of the outputs)
+        if self.stateful and self.carry is not None and self.carry_unsaved:
+            from tpudas.proc.stream import save_carry
+
+            save_carry(self.carry, self.output_folder)
+            self.carry_unsaved = 0
+        # final snapshot on clean termination: quarantine/degradation
+        # state from the LAST poll (a file can be quarantined by the
+        # very poll that terminates the loop) must be visible
+        self.edge_health.write(
+            self.counters, self.rounds, self.polls,
+            self._mode(), self.round_rt, self.head_lag,
+        )
+
+    def record_fatal(self, exc: BaseException) -> None:
+        # terminal failure: the LAST health snapshot an operator sees
+        # must say why the stream died (the process is about to exit)
+        self.edge_health.last_error = (
+            f"{type(exc).__name__}: {str(exc)[:300]}"
+        )
+        get_registry().counter(
+            "tpudas_stream_errors_total",
+            "realtime driver crashes (recorded in health.json)",
+        ).inc()
+        self.edge_health.write(
+            self.counters, self.rounds, self.polls,
+            self._mode(), 0.0, None,
+        )
+
+
+# fresh patches processed per batched-rolling chunk: bounds the host
+# stack (a first poll over a large pre-existing archive makes EVERY
+# file fresh at once) while still amortizing the batched dispatch
+_ROLLING_BATCH_CHUNK = 32
+
+
+class RollingStreamRunner(StreamRunner):
+    """One stateless rolling-mean stream: the hoisted
+    ``run_rolling_realtime`` round loop (see that shim's docstring)."""
+
+    kind = "rolling"
+
+    def __init__(self, spec: StreamSpec, output_folder: str):
+        super().__init__(spec, output_folder)
+        cfg = spec.config
+        if cfg.kind != "rolling":
+            raise ValueError(
+                f"RollingStreamRunner needs kind='rolling', got "
+                f"{cfg.kind!r}"
+            )
+        from tpudas.core import units as _units
+        from tpudas.parallel.mesh import resolve_mesh
+
+        self.mesh = resolve_mesh(cfg.mesh)
+        if self.mesh is not None and "ch" not in self.mesh.shape:
+            raise ValueError(
+                "run_rolling_realtime mesh needs a 'ch' axis (use "
+                "tpudas.parallel.mesh.make_mesh); got axes "
+                f"{tuple(self.mesh.shape)}"
+            )
+        self.window = cfg.window
+        self.step_param = cfg.step
+        self.scale = float(cfg.scale)
+        self.distance = cfg.distance
+        self.engine = cfg.engine
+        os.makedirs(self.output_folder, exist_ok=True)
+        _startup_audit(self.output_folder)
+        file_duration = (
+            30.0 if cfg.file_duration is None else float(cfg.file_duration)
+        )
+        self.interval = (
+            float(cfg.poll_interval)
+            if cfg.poll_interval is not None
+            else file_duration
+        )
+        policy = (
+            cfg.fault_policy if cfg.fault_policy is not None
+            else RetryPolicy()
+        )
+        ledger = (
+            QuarantineLedger(self.output_folder) if cfg.quarantine
+            else None
+        )
+        self.boundary = FaultBoundary(policy, ledger)
+        pyramid = cfg.pyramid
+        if pyramid is None:
+            pyramid = os.environ.get("TPUDAS_PYRAMID", "0") == "1"
+        self.pyramid = bool(pyramid)
+        detect = cfg.detect
+        if detect is None:
+            detect = os.environ.get("TPUDAS_DETECT", "0") == "1"
+        self.detect = bool(detect)
+        self.detect_operators = cfg.detect_operators
+        self.step_sec = _units.get_seconds(cfg.step)
+        self.pyr_state = {"store": None}  # cross-round open tile store
+        self.det_state = {"pipe": None}  # cross-round detect pipeline
+        self.initial_run = True
+        # identify patches by their time span so a late-arriving file
+        # with an earlier timestamp is still processed (a positional
+        # high-water mark into the time-sorted spool would skip it)
+        self.processed: set = set()
+
+    def step(self) -> StepResult:
+        from tpudas.integrity import resource as _resource
+
+        self.polls += 1
+        try:
+            fault_point("round.body", poll=self.polls)
+            sp = self.boundary.begin_round(
+                make_spool(self.source).sort("time"), self.source
+            )
+            sub = (
+                sp.select(distance=self.distance)
+                if self.distance is not None
+                else sp
+            )
+            contents = sub.get_contents()
+            keys = [
+                (np.datetime64(a, "ns"), np.datetime64(b, "ns"))
+                for a, b in zip(
+                    contents["time_min"], contents["time_max"]
+                )
+            ]
+            fresh = [
+                j for j, k in enumerate(keys) if k not in self.processed
+            ]
+            if (
+                not self.initial_run
+                and not fresh
+                and self.boundary.consecutive == 0
+            ):
+                print("No new data was detected. Real-time data processing ended successfully.")
+                return StepResult("terminate")
+            status = "empty"
+            if fresh:
+                status = "processed"
+                self._process_round(sub, keys, fresh)
+            self.boundary.on_success()
+            if _resource.is_degraded():
+                _resource.probe_recovery(self.output_folder)
+            self.initial_run = False
+        except Exception as exc:
+            self.pyr_state["store"] = None
+            self.det_state["pipe"] = None
+            decision = self.boundary.on_failure(exc)
+            if decision.propagate:
+                raise
+            return StepResult(
+                "retry", decision.delay, decision.kind,
+                self.boundary.consecutive,
+            )
+        return StepResult(status, self.poll_delay())
+
+    def _process_round(self, sub, keys, fresh) -> None:
+        from tpudas.integrity import resource as _resource
+
+        rnd = self.rounds + 1
+        print("run number: ", rnd)
+        emitted_patches = []  # in-memory capture (pyramid/detect)
+
+        def write_out(j, out):
+            out = out.new(data=np.asarray(out.data) * self.scale)
+            fname = get_filename(
+                out.attrs["time_min"], out.attrs["time_max"]
+            )
+            out.io.write(
+                os.path.join(self.output_folder, fname), "dasdae"
+            )
+            self.processed.add(keys[j])
+            if self.pyramid or self.detect:
+                emitted_patches.append(out)
+
+        # bounded chunks: memory stays O(chunk), outputs are written
+        # as soon as they are computed
+        for c0 in range(0, len(fresh), _ROLLING_BATCH_CHUNK):
+            chunk = fresh[c0 : c0 + _ROLLING_BATCH_CHUNK]
+            outs = None
+            if (
+                self.mesh is not None
+                and self.engine not in ("numpy", "host")
+                and len(chunk) > 1
+            ):
+                from tpudas.ops.rolling import (
+                    rolling_mean_patches_batched,
+                )
+
+                patches = [sub[j] for j in chunk]
+                outs = rolling_mean_patches_batched(
+                    self.mesh, patches, self.window, self.step_param
+                )
+                if outs is not None:
+                    log_event(
+                        "rolling_batched",
+                        patches=len(chunk),
+                        mesh=dict(self.mesh.shape),
+                    )
+                    for j, out in zip(chunk, outs):
+                        write_out(j, out)
+            if outs is None:
+                for j in chunk:
+                    print("working on patch ", j)
+                    write_out(
+                        j,
+                        sub[j]
+                        .rolling(
+                            time=self.window, step=self.step_param,
+                            engine=self.engine,
+                        )
+                        .mean(),
+                    )
+        # driver parity with the lowpass runner: the same per-round
+        # serve/detect append hooks over the same in-memory capture
+        if self.pyramid and not _resource.should_shed("pyramid"):
+            _append_pyramid(
+                self.output_folder, rnd, emitted_patches,
+                self.pyr_state,
+            )
+        if self.detect:
+            from tpudas.detect.runner import (
+                mark_detect_shed,
+                run_detect_round,
+            )
+
+            if _resource.should_shed("detect"):
+                mark_detect_shed(self.det_state)
+            else:
+                run_detect_round(
+                    self.output_folder, rnd, emitted_patches,
+                    self.det_state, operators=self.detect_operators,
+                    step_sec=self.step_sec,
+                )
+        self.rounds = rnd
+
+
+def build_runner(
+    spec: StreamSpec,
+    root=None,
+    counters: Counters | None = None,
+    on_round=None,
+) -> StreamRunner:
+    """Construct the right runner for ``spec`` (folders created,
+    startup audit run, carry to be resolved on the first round)."""
+    folder = spec.resolve_output_folder(root if root is not None else ".")
+    if spec.config.kind == "lowpass":
+        return LowpassStreamRunner(
+            spec, folder, counters=counters, on_round=on_round
+        )
+    return RollingStreamRunner(spec, folder)
+
+
+def drive(runner: StreamRunner, max_rounds=None, sleep_fn=_time.sleep):
+    """The single-stream driver loop over one runner: step, honor the
+    ``max_rounds`` poll cap, sleep the advisory delay (the retry
+    backoff inside the ``stream.retry`` span, exactly as the pre-fleet
+    drivers did), flush on clean termination.  Returns the number of
+    rounds that processed data."""
+    try:
+        while True:
+            res = runner.step()
+            if res.status == "terminate":
+                break
+            if max_rounds is not None and runner.polls >= max_rounds:
+                break
+            if res.status == "retry":
+                with span(
+                    "stream.retry", kind=res.kind, attempt=res.attempt
+                ):
+                    sleep_fn(res.delay)
+            else:
+                sleep_fn(res.delay)
+    except Exception as exc:
+        runner.record_fatal(exc)
+        raise
+    runner.finish()
+    return runner.rounds

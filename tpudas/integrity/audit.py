@@ -58,6 +58,13 @@ writers have not started.
 A second audit immediately after a repairing one reports ``clean``
 with zero issues — the crash-drill (tools/crash_drill.py) asserts
 exactly that after every SIGKILL.
+
+Fleets (ISSUE 8): a :class:`tpudas.fleet.FleetEngine` root holds one
+output folder per stream (``root/<stream_id>/``).  :func:`audit_fleet`
+runs the same audit over every stream root and aggregates the
+reports; ``tools/fsck.py --fleet`` and ``tools/crash_drill.py
+--streams N`` drive it.  Each stream is classified and repaired
+independently — one stream's damage never touches another's state.
 """
 
 from __future__ import annotations
@@ -78,7 +85,7 @@ from tpudas.obs.trace import span
 from tpudas.utils.atomicio import is_tmp_name
 from tpudas.utils.logging import log_event
 
-__all__ = ["audit"]
+__all__ = ["audit", "audit_fleet", "fleet_stream_dirs"]
 
 _TILE_NAME_RE = re.compile(r"^(\d{8})\.npy$")
 
@@ -934,5 +941,70 @@ def audit(folder, repair: bool = True, rebuild: bool = True) -> dict:
             clean=clean,
             repaired=repaired,
             counts=counts,
+        )
+    return report
+
+
+def fleet_stream_dirs(root) -> list:
+    """``[(stream_id, path), ...]`` for every stream root under a
+    fleet root: the non-hidden subdirectories, sorted by name (the
+    :class:`tpudas.fleet.FleetEngine` layout — stream ids cannot start
+    with a dot, so dot-dirs beside the streams are fleet bookkeeping,
+    e.g. a shared compile cache)."""
+    root = str(root)
+    out = []
+    if os.path.isdir(root):
+        for name in sorted(os.listdir(root)):
+            if name.startswith("."):
+                continue
+            path = os.path.join(root, name)
+            if os.path.isdir(path):
+                out.append((name, path))
+    return out
+
+
+def audit_fleet(root, repair: bool = True, rebuild: bool = True) -> dict:
+    """Run :func:`audit` over every stream root under ``root`` and
+    aggregate: ``report["clean"]`` is True only when EVERY stream is.
+    Per-stream reports land under ``report["streams"][stream_id]`` —
+    each stream is classified and repaired independently, so a
+    wrecked stream cannot block its neighbors' repair.  Run only
+    while the fleet is stopped (the same tmp-sweep caveat as the
+    single-stream audit)."""
+    streams = {}
+    issues_total = 0
+    repaired_total = 0
+    for stream_id, path in fleet_stream_dirs(root):
+        rep = audit(path, repair=repair, rebuild=rebuild)
+        streams[stream_id] = rep
+        issues_total += len(rep["issues"])
+        repaired_total += rep["repaired"]
+    # a fleet root with nothing to audit is NOT clean: a typo'd path
+    # or an emptied root must not read as a passing fsck
+    error = None
+    if not streams:
+        error = (
+            "no stream folders found under fleet root "
+            f"{str(root)!r} (nothing was audited)"
+        )
+    report = {
+        "root": str(root),
+        "repair": bool(repair),
+        "clean": bool(streams)
+        and all(r["clean"] for r in streams.values()),
+        "streams": streams,
+        "stream_count": len(streams),
+        "issues_total": issues_total,
+        "repaired_total": repaired_total,
+    }
+    if error is not None:
+        report["error"] = error
+    if issues_total:
+        log_event(
+            "integrity_audit_fleet",
+            root=str(root),
+            clean=report["clean"],
+            streams=len(streams),
+            repaired=repaired_total,
         )
     return report

@@ -35,286 +35,57 @@ reference's ``on_gap="raise"`` — propagate.  A retried round resumes
 exactly like a crash does: the in-memory carry is dropped and
 re-resolved from disk (reconcile included), so the crash-only
 invariant is untouched.  See RESILIENCE.md.
+
+Since ISSUE 8 the round loops themselves live in the fleet round
+engine (:mod:`tpudas.fleet.engine`): both drivers here are thin,
+kwarg-compatible shims — a :class:`tpudas.fleet.StreamConfig` + a
+runner + :func:`tpudas.fleet.engine.drive` — and the SAME runner code
+schedules N concurrent streams under one process via
+:class:`tpudas.fleet.FleetEngine` (see FLEET.md).
+``tools/check_driver_parity.py`` lints that these shims and
+``StreamConfig`` can never drift apart.
 """
 
 from __future__ import annotations
 
-import math
-import os
+import re
 import time as _time
 
-import numpy as np
-
-from tpudas.core.timeutils import to_datetime64, to_timedelta64
-from tpudas.io.spool import spool as make_spool
-from tpudas.obs.health import write_health, write_prom
-from tpudas.obs.registry import get_registry
-from tpudas.obs.trace import span
-from tpudas.proc.lfproc import LFProc, resolve_gap_tolerance
-from tpudas.proc.naming import get_filename
-from tpudas.resilience.faults import (
-    FaultBoundary,
-    RetryPolicy,
-    fault_point,
+from tpudas.fleet.config import StreamConfig, StreamSpec
+from tpudas.fleet.engine import (  # noqa: F401 - re-exported legacy API
+    POLL_FLOOR_SEC,
+    _ROLLING_BATCH_CHUNK,
+    _append_pyramid,
+    _covered_workload,
+    _EdgeHealth,
+    _finite,
+    _head_lag_seconds,
+    _startup_audit,
+    build_runner,
+    clamp_poll_interval,
+    drive,
 )
-from tpudas.resilience.quarantine import QuarantineLedger
-from tpudas.utils.logging import log_event
-from tpudas.utils.profiling import Counters
+from tpudas.proc.lfproc import resolve_gap_tolerance
 
 __all__ = ["clamp_poll_interval", "run_lowpass_realtime", "run_rolling_realtime"]
 
 
-class _EdgeHealth:
-    """Per-run health bookkeeping for the realtime driver: assembles
-    the ``health.json`` payload (schema: tpudas.obs.health) and drops
-    it — plus the Prometheus exposition — beside the stream carry
-    every round.  Enabled by ``TPUDAS_HEALTH=1`` (or the driver's
-    ``health=True``); write failures are counted and swallowed.
+def _shim_stream_id(output_folder) -> str:
+    """A jitter-seed/bookkeeping id for a single-stream driver run,
+    derived from the output folder (sanitized to the StreamSpec id
+    alphabet; the id has no on-disk effect here — the shim passes the
+    output folder explicitly)."""
+    import os
+    import zlib
 
-    Integrity fields (schema v3): ``integrity_fallbacks`` is the
-    per-run count of verified reads that rejected a primary artifact
-    and took a degradation-ladder step; ``resource_degraded`` mirrors
-    the disk-full shedding flag.  Either condition marks the snapshot
-    ``degraded`` — recovery happened (or writers are shed), the
-    operator should know.  Under resource pressure ``metrics.prom`` is
-    shed (counted) while ``health.json`` itself keeps being written:
-    it is the operator's only window into the degradation."""
-
-    def __init__(self, folder, enabled, boundary=None):
-        from tpudas.integrity.checksum import fallback_count
-
-        self.folder = folder
-        self.enabled = enabled
-        self.boundary = boundary  # FaultBoundary (degradation fields)
-        self.carry_resumes = 0
-        self.last_error = None
-        # optional detect summary (tpudas.detect) — surfaced in the
-        # snapshot (and through /healthz) as a "detect" sub-object;
-        # not part of the required schema, absent when detect is off
-        self.detect = None
-        self._fb0 = fallback_count()  # run baseline for the delta
-
-    def integrity_fallbacks(self) -> int:
-        from tpudas.integrity.checksum import fallback_count
-
-        return fallback_count() - self._fb0
-
-    def write(self, counters, rounds, polls, mode, round_rt, head_lag):
-        if not self.enabled:
-            return
-        from tpudas.integrity import resource as _resource
-
-        b = self.boundary
-        fallbacks = self.integrity_fallbacks()
-        res_degraded = _resource.is_degraded()
-        degraded = (
-            (False if b is None else b.degraded)
-            or res_degraded
-            or fallbacks > 0
-        )
-        payload_extra = (
-            {} if self.detect is None else {"detect": self.detect}
-        )
-        write_health(
-            self.folder,
-            {
-                **payload_extra,
-                "rounds": rounds,
-                "polls": polls,
-                "mode": mode,
-                "realtime_factor": round(counters.realtime_factor, 3),
-                "round_realtime_factor": round(round_rt, 3),
-                "head_lag_seconds": (
-                    None if head_lag is None else round(head_lag, 3)
-                ),
-                "redundant_ratio": round(counters.redundant_ratio, 4),
-                "carry_resume_count": self.carry_resumes,
-                "last_round_wall_seconds": round(counters.last_wall, 4),
-                "consecutive_failures": 0 if b is None else b.consecutive,
-                "quarantined_files": (
-                    0 if b is None else b.quarantined_count
-                ),
-                "degraded": degraded,
-                "integrity_fallbacks": fallbacks,
-                "resource_degraded": res_degraded,
-                "last_error": self.last_error
-                or (None if b is None else b.last_error),
-            },
-        )
-        if not _resource.should_shed("prom"):
-            write_prom(self.folder)
-
-
-def _startup_audit(output_folder) -> None:
-    """The drivers' pre-first-round fsck (tpudas.integrity.audit):
-    sweep stale tmp files, verify every durable artifact, repair via
-    the .prev/rebuild ladder.  Disable with
-    ``TPUDAS_INTEGRITY_AUDIT=0``.  Never raises — an audit failure
-    must not take down the stream it protects (counted + logged)."""
-    if os.environ.get("TPUDAS_INTEGRITY_AUDIT", "1") == "0":
-        return
-    try:
-        from tpudas.integrity.audit import audit
-
-        report = audit(output_folder, repair=True)
-        if report["issues"]:
-            print(
-                f"Integrity audit repaired {report['repaired']} "
-                f"artifact(s) in {output_folder} "
-                f"(clean={report['clean']})"
-            )
-    except Exception as exc:
-        get_registry().counter(
-            "tpudas_integrity_audit_errors_total",
-            "startup integrity audits that raised (swallowed)",
-        ).inc()
-        log_event(
-            "integrity_audit_failed",
-            folder=str(output_folder),
-            error=f"{type(exc).__name__}: {str(exc)[:200]}",
-        )
-
-
-def _append_pyramid(output_folder, rnd, emitted, state) -> None:
-    """Per-round serve-side hook: cascade this round's new output rows
-    into the :mod:`tpudas.serve.tiles` pyramid beside the carry.
-
-    ``emitted`` holds the round's output patches captured in memory at
-    their write site (an ``LFProc.add_emit_listener`` subscription),
-    so the steady-state append costs tile IO only — no index rescan,
-    no re-reading files this process just wrote.  ``state["store"]`` carries the open store
-    across rounds (a stat-gated refresh per round, not a re-parse);
-    it is dropped to None on any failure — exactly the carry's
-    crash-equivalent discipline — and any discontinuity (fresh
-    folder, crashed append) falls back to the file-backed sync, so a
-    retried or crash-resumed round needs no pyramid bookkeeping: disk
-    is the only durable state.  A pyramid failure is counted and
-    swallowed: the read side degrades (the query engine falls back to
-    full-resolution files), the write side must not."""
-    from tpudas.serve.tiles import CorruptStoreError, append_patches
-
-    reg = get_registry()
-    t0 = _time.perf_counter()
-    try:
-        with span("serve.pyramid_append", round=rnd):
-            appended, state["store"] = append_patches(
-                output_folder, emitted, store=state.get("store")
-            )
-    except Exception as exc:
-        state["store"] = None  # crash-equivalent: re-resolve from disk
-        reg.counter(
-            "tpudas_serve_pyramid_errors_total",
-            "per-round pyramid appends that failed (swallowed; the "
-            "query engine falls back to full-resolution files)",
-        ).inc()
-        log_event(
-            "pyramid_append_failed",
-            round=rnd,
-            error=f"{type(exc).__name__}: {str(exc)[:200]}",
-        )
-        from tpudas.integrity import resource as _resource
-
-        if _resource.is_resource_error(exc):
-            # disk full: flip the shedding flag so the NEXT rounds
-            # skip the append instead of re-failing it
-            _resource.note_pressure("pyramid", exc)
-        elif isinstance(exc, CorruptStoreError):
-            # the store itself is bad (torn tails, checksum-failed
-            # tile): the ladder's last rung — delete + rebuild from
-            # the output files, byte-identical, mid-run
-            from tpudas.serve.tiles import rebuild_pyramid
-
-            try:
-                rebuild_pyramid(output_folder)
-            except Exception as exc2:
-                log_event(
-                    "pyramid_rebuild_failed",
-                    round=rnd,
-                    error=f"{type(exc2).__name__}: {str(exc2)[:200]}",
-                )
-        return
-    reg.histogram(
-        "tpudas_serve_pyramid_append_seconds",
-        "per-round tile-pyramid append wall time",
-    ).observe(_time.perf_counter() - t0)
-    if appended:
-        log_event("pyramid_append", round=rnd, rows=int(appended))
-
-
-def _head_lag_seconds(t2, lfp, carry) -> float | None:
-    """Stream-seconds between the fiber head (newest indexed input,
-    ``t2``) and the newest emitted output — the operator's "how far
-    behind live am I" number.  None before the first output."""
-    t_out_ns = None
-    if carry is not None and carry.last_emit_ns is not None:
-        t_out_ns = int(carry.last_emit_ns)
-    else:
-        try:
-            t_out_ns = int(
-                to_datetime64(lfp.get_last_processed_time())
-                .astype("datetime64[ns]")
-                .astype(np.int64)
-            )
-        except Exception:
-            return None
-    t2_ns = int(
-        np.datetime64(t2, "ns").astype(np.int64)
-    )
-    return (t2_ns - t_out_ns) / 1e9
-
-
-def _finite(value) -> float:
-    """Coerce an index cell to a finite float (0.0 for None/NaN/junk) —
-    a heterogeneous or legacy index row must degrade the metric, never
-    crash the processing loop."""
-    try:
-        v = float(value)
-    except (TypeError, ValueError):
-        return 0.0
-    return v if math.isfinite(v) else 0.0
-
-
-def _covered_workload(contents, t1, t2):
-    """(data_seconds, channel_samples) actually present in the index
-    within [t1, t2) — gaps and heterogeneous files are accounted per
-    file, so round metrics stay honest across outages and rewinds."""
-    lo = to_datetime64(t1).astype("datetime64[ns]")
-    hi = to_datetime64(t2).astype("datetime64[ns]")
-    data_ns = 0.0
-    samples = 0.0
-    for _, row in contents.iterrows():
-        f_lo = np.datetime64(row["time_min"], "ns")
-        f_hi = np.datetime64(row["time_max"], "ns")
-        span_ns = (f_hi - f_lo) / np.timedelta64(1, "ns")
-        ov = min(hi, f_hi) - max(lo, f_lo)
-        ov_ns = ov / np.timedelta64(1, "ns")
-        if ov_ns <= 0:
-            continue
-        data_ns += ov_ns
-        n_time = _finite(row.get("ntime"))
-        if span_ns > 0 and n_time > 1:
-            fs = (n_time - 1) / (span_ns / 1e9)
-            samples += ov_ns / 1e9 * fs * _finite(row.get("ndistance"))
-    return data_ns / 1e9, samples
-
-
-POLL_FLOOR_SEC = 125.0
-
-
-def clamp_poll_interval(requested, file_duration, edge_buffer):
-    """The reference's cadence guard
-    (low_pass_dascore_edge.ipynb:165-173): the poll interval is
-    ``max(125 s, file duration, 3 * edge buffer)`` — and never faster
-    than requested. The absolute 125 s floor is unconditional; it
-    bounds the chance of reading a file the interrogator is still
-    mid-writing (the only race surface in the crash-only design).
-    Tests inject ``sleep_fn`` rather than lowering the clamp."""
-    return max(
-        float(requested),
-        POLL_FLOOR_SEC,
-        float(file_duration),
-        3.0 * float(edge_buffer),
-    )
+    path = os.path.normpath(str(output_folder))
+    base = re.sub(r"[^A-Za-z0-9._-]", "-", os.path.basename(path))
+    # StreamSpec ids must start alphanumeric and fit in 64 chars; any
+    # basename must sanitize into that alphabet (never raise).  The
+    # full-path hash keeps ids — and so the jitter seeds — distinct
+    # for co-located drivers whose basenames collide (/a/out, /b/out)
+    base = re.sub(r"^[^A-Za-z0-9]+", "", base)[:55] or "stream"
+    return f"{base}-{zlib.crc32(path.encode()):08x}"
 
 
 def run_lowpass_realtime(
@@ -349,6 +120,7 @@ def run_lowpass_realtime(
     pyramid=None,
     detect=None,
     detect_operators=None,
+    poll_jitter=None,
 ):
     """Poll ``source`` and keep the low-pass output current.
 
@@ -441,489 +213,55 @@ def run_lowpass_realtime(
     and re-resolved from disk.  See RESILIENCE.md for the taxonomy and
     the operator runbook.
 
+    ``poll_jitter`` (fraction, default 0 / ``TPUDAS_POLL_JITTER``)
+    stretches each poll interval by up to that fraction, drawn from a
+    deterministic per-stream LCG seeded by the output folder's name —
+    co-located streams (and fleet members, where the default is 0.1)
+    de-synchronize their spool scans instead of thundering-herding the
+    filesystem.  See :class:`tpudas.fleet.PollJitter`.
+
     Returns the number of rounds that processed data. Terminates when a
     poll sees no new files (reference semantics) or after
     ``max_rounds`` polls (retries consume polls, so a bounded test can
     never spin forever).
     """
-    if rolling_output_folder is None and (
-        rolling_window is not None or rolling_step is not None
-    ):
-        raise ValueError(
-            "rolling_window/rolling_step require rolling_output_folder "
-            "(the joint-pipeline switch) — without it no rolling "
-            "product would be written"
-        )
-    d_t = float(output_sample_interval)
-    buff_out = int(np.ceil(edge_buffer / d_t))
-    interval = clamp_poll_interval(poll_interval, file_duration, edge_buffer)
-    start_time = to_datetime64(start_time)
     gap_tol = resolve_gap_tolerance(data_gap_tolerance, data_gap_tolorance)
-    extra = {
-        k: v
-        for k, v in (
-            ("engine", engine),
-            ("on_gap", on_gap),
-            ("filter_order", filter_order),
-            ("data_gap_tolerance", gap_tol),
-            ("window_dp", window_dp),
-        )
-        if v is not None
-    }
-    from tpudas.parallel.mesh import resolve_mesh
-
-    mesh = resolve_mesh(mesh)
-    counters = counters if counters is not None else Counters()
-    if health is None:
-        health = os.environ.get("TPUDAS_HEALTH", "0") == "1"
-    policy = fault_policy if fault_policy is not None else RetryPolicy()
-    # carry/ledger/health/pyramid all live in the output folder; it
-    # must exist before the first processing round creates it
-    os.makedirs(output_folder, exist_ok=True)
-    # startup fsck BEFORE any persisted state (ledger, carry, pyramid)
-    # is loaded: stale tmp sweep, checksum verification, .prev
-    # promotion, pyramid rebuild — see tpudas.integrity.audit
-    _startup_audit(output_folder)
-    from tpudas.integrity import resource as _resource
-
-    if _resource.is_degraded():
-        # stale in-process pressure from a previous run: re-probe now
-        _resource.probe_recovery(output_folder)
-    if quarantine:
-        ledger = QuarantineLedger(output_folder)
-    else:
-        ledger = None
-    boundary = FaultBoundary(policy, ledger)
-    edge_health = _EdgeHealth(output_folder, bool(health), boundary)
-    reg = get_registry()
-    if pyramid is None:
-        pyramid = os.environ.get("TPUDAS_PYRAMID", "0") == "1"
-    pyramid = bool(pyramid)
-    if detect is None:
-        detect = os.environ.get("TPUDAS_DETECT", "0") == "1"
-    detect = bool(detect)
-
-    if stateful is None:
-        stateful = os.environ.get("TPUDAS_STREAM_STATEFUL", "1") != "0"
-    # a channel-only mesh keeps the stateful path (the carry shards
-    # over it, device-resident); a time-sharded mesh falls back to the
-    # window/rewind path, which owns the halo exchange
-    stateful = bool(stateful) and (
-        rolling_output_folder is None
-        and not window_dp
-        and (mesh is None or int(mesh.shape.get("time", 1)) <= 1)
+    config = StreamConfig(
+        kind="lowpass",
+        start_time=start_time,
+        output_sample_interval=output_sample_interval,
+        edge_buffer=edge_buffer,
+        process_patch_size=process_patch_size,
+        distance=distance,
+        poll_interval=poll_interval,
+        file_duration=file_duration,
+        engine=engine,
+        on_gap=on_gap,
+        filter_order=filter_order,
+        data_gap_tolerance=gap_tol,
+        window_dp=window_dp,
+        mesh=mesh,
+        rolling_output_folder=rolling_output_folder,
+        rolling_window=rolling_window,
+        rolling_step=rolling_step,
+        stateful=stateful,
+        carry_save_every=carry_save_every,
+        health=health,
+        fault_policy=fault_policy,
+        quarantine=quarantine,
+        pyramid=pyramid,
+        detect=detect,
+        detect_operators=detect_operators,
+        poll_jitter=poll_jitter,
     )
-    if carry_save_every is None:
-        carry_save_every = int(
-            os.environ.get("TPUDAS_CARRY_SAVE_EVERY", "") or 1
-        )
-    carry_save_every = max(1, int(carry_save_every))
-    carry = None  # the cross-round filter state (stateful mode)
-    carry_unsaved = 0  # completed rounds since the last carry save
-    carry_checked = False  # disk/legacy resolution happens once
-    rewind_wrote = False  # first rewind write invalidates any carry
-    pyr_state = {"store": None}  # cross-round open tile store (pyramid)
-    det_state = {"pipe": None}  # cross-round detect pipeline (detect)
-
-    processed_once = False  # first PROCESSING round always starts at
-    # start_time, however many empty polls precede it (a pre-existing
-    # output folder must not hijack the user's start point)
-    rounds = 0
-    polls = 0
-    prev_t2 = None  # previous round's processing head (redundancy metric)
-    len_last = None  # spool size at the previous poll (None = no poll yet)
-    round_rt = 0.0  # last round's realtime factor (final health snapshot)
-    head_lag = None
-    try:
-        while True:
-            polls += 1
-            reg.counter(
-                "tpudas_stream_polls_total", "source spool polls"
-            ).inc()
-            try:
-                fault_point("round.body", poll=polls)
-                # quarantine exclusion + index update + scan-failure
-                # strikes + slow-schedule probe bookkeeping
-                sp = boundary.begin_round(make_spool(source), source)
-                sub = (
-                    sp.select(distance=distance)
-                    if distance is not None
-                    else sp
-                )
-                n_now = len(sub)
-                if (
-                    len_last is not None
-                    and n_now == len_last
-                    and boundary.consecutive == 0
-                ):
-                    print("No new data was detected. Real-time processing ended successfully.")
-                    break
-                if n_now > 0:
-                    t_body = _time.perf_counter()
-                    joint_extra = {}
-                    if rolling_output_folder is not None:
-                        from tpudas.proc.joint import JointProc
-
-                        lfp = JointProc(sub, mesh=mesh)
-                        joint_extra = {
-                            k: v
-                            for k, v in (("rolling_window", rolling_window),
-                                         ("rolling_step", rolling_step))
-                            if v is not None
-                        }
-                    else:
-                        lfp = LFProc(sub, mesh=mesh)
-                    lfp.update_processing_parameter(
-                        output_sample_interval=d_t,
-                        process_patch_size=int(process_patch_size),
-                        edge_buff_size=buff_out,
-                        **extra,
-                        **joint_extra,
-                    )
-                    lfp.set_output_folder(
-                        output_folder, delete_existing=False
-                    )
-                    emitted_patches = []
-                    if pyramid or detect:
-                        # capture the round's output blocks at their
-                        # write site for the in-memory pyramid append
-                        # and the detect operators (multi-subscriber
-                        # emit hook — one shared capture serves both)
-                        lfp.add_emit_listener(emitted_patches.append)
-                    if rolling_output_folder is not None:
-                        lfp.set_rolling_output_folder(
-                            rolling_output_folder, delete_existing=False
-                        )
-                    # committed to `rounds` only when the attempt
-                    # completes — a failed attempt is a retry, not a
-                    # processed round
-                    rnd = rounds + 1
-                    print("run number: ", rnd)
-                    if stateful and not carry_checked:
-                        # one-time disk resolution: resume a persisted
-                        # carry, or fall back to rewind mode for a legacy
-                        # folder that has outputs but no carry (its resume
-                        # point is only expressible as a rewind)
-                        carry_checked = True
-                        from tpudas.proc.stream import (
-                            carry_matches,
-                            load_carry,
-                            reconcile_outputs,
-                        )
-
-                        carry = load_carry(output_folder)
-                        if carry is not None and not carry_matches(
-                            carry, lfp, start_time
-                        ):
-                            raise ValueError(
-                                "persisted stream carry in "
-                                f"{output_folder} was produced under a "
-                                "different start_time or processing "
-                                "parameters; delete it (or the folder) to "
-                                "change configuration"
-                            )
-                        if carry is not None:
-                            # patch_size only shapes chunking — honor the
-                            # live setting rather than the persisted one
-                            carry.patch_out = int(process_patch_size)
-                            reconcile_outputs(output_folder, carry)
-                            log_event("stream_resume", emitted=carry.emitted)
-                            edge_health.carry_resumes += 1
-                            reg.counter(
-                                "tpudas_stream_carry_resumes_total",
-                                "rounds resumed from a persisted stream "
-                                "carry",
-                            ).inc()
-                        else:
-                            try:
-                                lfp.get_last_processed_time()
-                                has_outputs = True
-                            except (FileNotFoundError, IndexError) as exc:
-                                # the two EXPECTED "no outputs yet"
-                                # signals (virgin/empty folder); a real
-                                # IO error must not be misread as "no
-                                # outputs" — it propagates to the fault
-                                # boundary instead
-                                has_outputs = False
-                                log_event(
-                                    "stream_no_prior_outputs",
-                                    reason=(
-                                        f"{type(exc).__name__}: "
-                                        f"{str(exc)[:120]}"
-                                    ),
-                                )
-                            if has_outputs:
-                                stateful = False
-                                print(
-                                    "Existing output folder has no stream "
-                                    "carry; continuing in rewind mode"
-                                )
-                                log_event("stream_legacy_rewind")
-                            else:
-                                carry = lfp.open_stream(start_time)
-                                # persist BEFORE the first outputs: a
-                                # crash mid-round-1 then still reads as a
-                                # stateful folder (reconcile + resume)
-                                # instead of degrading to rewind mode
-                                # forever via the legacy heuristic above
-                                from tpudas.proc.stream import save_carry
-
-                                save_carry(carry, output_folder)
-                    # newest timestamp from the index — no file data is
-                    # read
-                    contents = sub.get_contents()
-                    t2 = np.datetime64(contents["time_max"].max())
-                    redundant = 0.0
-                    if stateful:
-                        # carried state: only NEW samples are read/filtered
-                        t1 = (
-                            np.datetime64(int(carry.next_ingest_ns), "ns")
-                            if carry.next_ingest_ns is not None
-                            else start_time
-                        )
-                        data_sec, ch_samples = _covered_workload(
-                            contents, t1, t2
-                        )
-                        with span(
-                            "stream.round", mode="stateful", round=rnd
-                        ), counters.measure(int(ch_samples), data_sec):
-                            lfp.process_stream_increment(carry, t2)
-                        from tpudas.proc.stream import save_carry
-
-                        # saved AFTER the outputs: the carry is never ahead
-                        # of the files (crash-only; resume reconciles the
-                        # rest).  On a >1 cadence the skipped rounds keep
-                        # the pytree on-device — a crash simply resumes
-                        # from the last save and regenerates the tail
-                        # byte-identically.
-                        carry_unsaved += 1
-                        if carry_unsaved >= carry_save_every:
-                            save_carry(carry, output_folder)
-                            carry_unsaved = 0
-                    else:
-                        resumed_stateful = False
-                        if not rewind_wrote:
-                            # a persisted carry means the folder head was
-                            # written by the stateful mode; this rewind
-                            # write breaks the carry's no-newer-outputs
-                            # invariant, so invalidate it — and CONTINUE
-                            # from the folder head (the t_last resume
-                            # below) rather than reprocessing from
-                            # start_time, leaving every stateful-era
-                            # product file untouched
-                            rewind_wrote = True
-                            from tpudas.proc.stream import discard_carry
-
-                            if discard_carry(output_folder):
-                                resumed_stateful = True
-                                print(
-                                    "Removed stale stream carry; rewind "
-                                    "mode continues from the folder head"
-                                )
-                        if not processed_once and not resumed_stateful:
-                            t1 = start_time
-                        else:
-                            try:
-                                t_last = lfp.get_last_processed_time()
-                            except IndexError:
-                                # a prior round completed without emitting
-                                # output (stream still shorter than the
-                                # edge trim) — no checkpoint yet, retry
-                                # from the very start
-                                t_last = None
-                            if t_last is None:
-                                t1 = start_time
-                            else:
-                                # rewind (ceil(edge/dt) - 1) output steps,
-                                # exactly on the output grid — ns precision
-                                # so fractional d_t stays seam-free (the
-                                # resumed run's first emitted sample is
-                                # then t_last + d_t)
-                                rewind_sec = (
-                                    math.ceil(edge_buffer / d_t) - 1
-                                ) * d_t
-                                t1 = t_last - to_timedelta64(rewind_sec)
-                        data_sec, ch_samples = _covered_workload(
-                            contents, t1, t2
-                        )
-                        if prev_t2 is not None and t1 < prev_t2:
-                            # full-rate samples re-read solely to rebuild
-                            # the filter's transient state (what stateful
-                            # mode eliminates)
-                            _, redundant = _covered_workload(
-                                contents, t1, min(prev_t2, t2)
-                            )
-                            counters.add_redundant(int(redundant))
-                        with span(
-                            "stream.round", mode="rewind", round=rnd
-                        ), counters.measure(int(ch_samples), data_sec):
-                            lfp.process_time_range(t1, t2)
-                    prev_t2 = t2
-                    rounds = rnd
-                    round_rt = (
-                        data_sec / counters.last_wall
-                        if counters.last_wall
-                        else 0.0
-                    )
-                    mode_str = "stateful" if stateful else "rewind"
-                    log_event(
-                        "realtime_round",
-                        round=rnd,
-                        upto=str(t2),
-                        mode=mode_str,
-                        data_seconds=round(data_sec, 3),
-                        redundant_samples=int(redundant),
-                        wall_seconds=round(counters.last_wall, 4),
-                        realtime_factor=round(round_rt, 2),
-                        engine=lfp.parameters["engine"],
-                        engine_counts=dict(lfp.engine_counts),
-                        native_windows=lfp.native_windows,
-                    )
-                    reg.counter(
-                        "tpudas_stream_rounds_total",
-                        "processing rounds completed",
-                        labelnames=("mode",),
-                    ).inc(mode=mode_str)
-                    reg.histogram(
-                        "tpudas_stream_round_seconds",
-                        "per-round measured processing wall time",
-                    ).observe(counters.last_wall)
-                    reg.gauge(
-                        "tpudas_stream_realtime_factor",
-                        "last round's data-seconds per wall-second",
-                    ).set(round_rt)
-                    reg.gauge(
-                        "tpudas_stream_redundant_ratio",
-                        "cumulative fraction of channel-samples re-read to "
-                        "rebuild filter state",
-                    ).set(counters.redundant_ratio)
-                    # stateful head lag is O(1) off the carry; the rewind
-                    # fallback rescans the output index, so only pay it
-                    # when an operator is actually scraping health
-                    head_lag = (
-                        _head_lag_seconds(
-                            t2, lfp, carry if stateful else None
-                        )
-                        if (stateful or edge_health.enabled)
-                        else None
-                    )
-                    if head_lag is not None:
-                        reg.gauge(
-                            "tpudas_stream_head_lag_seconds",
-                            "stream-seconds between the fiber head and the "
-                            "newest emitted output",
-                        ).set(head_lag)
-                    if pyramid and not _resource.should_shed("pyramid"):
-                        _append_pyramid(
-                            output_folder, rnd, emitted_patches,
-                            pyr_state,
-                        )
-                    if detect:
-                        from tpudas.detect.runner import (
-                            mark_detect_shed,
-                            run_detect_round,
-                        )
-
-                        if _resource.should_shed("detect"):
-                            mark_detect_shed(det_state)
-                        else:
-                            run_detect_round(
-                                output_folder, rnd, emitted_patches,
-                                det_state, operators=detect_operators,
-                                step_sec=d_t,
-                            )
-                        edge_health.detect = det_state.get("summary")
-                    boundary.on_success()
-                    edge_health.write(
-                        counters, rnd, polls, mode_str, round_rt, head_lag
-                    )
-                    reg.histogram(
-                        "tpudas_stream_round_body_seconds",
-                        "full processing-round wall time (index update "
-                        "through health write, pyramid append included)",
-                    ).observe(_time.perf_counter() - t_body)
-                    if on_round is not None:
-                        on_round(rnd, lfp)
-                    processed_once = True
-                else:
-                    boundary.on_success()
-                if _resource.is_degraded():
-                    # disk-full recovery probe: one tiny write — the
-                    # moment it succeeds, shed writers resume and the
-                    # pyramid backfills from the output files
-                    _resource.probe_recovery(output_folder)
-                # every poll (including an empty first one) sets the
-                # growth baseline: the next no-growth poll terminates
-                # (reference semantics — the loop ends when the spool
-                # stops growing, low_pass_dascore_edge.ipynb:205-207)
-                len_last = n_now
-            except Exception as exc:
-                decision = boundary.on_failure(exc)
-                if decision.propagate:
-                    raise
-                # crash-equivalent retry: drop the in-memory carry and
-                # re-resolve it from disk on the next attempt — the
-                # resume path reconciles any partial outputs exactly as
-                # a process restart would, so a retried round and a
-                # crash-restart are the same code path
-                if stateful:
-                    carry = None
-                    carry_checked = False
-                    carry_unsaved = 0
-                pyr_state["store"] = None
-                det_state["pipe"] = None
-                edge_health.write(
-                    counters, rounds, polls,
-                    "stateful" if stateful else "rewind", 0.0, None,
-                )
-                if max_rounds is not None and polls >= max_rounds:
-                    break
-                with span(
-                    "stream.retry",
-                    kind=decision.kind,
-                    attempt=boundary.consecutive,
-                ):
-                    sleep_fn(decision.delay)
-                continue
-            if max_rounds is not None and polls >= max_rounds:
-                break
-            sleep_fn(interval)
-    except Exception as exc:
-        # terminal failure: the LAST health snapshot an operator sees
-        # must say why the stream died (the process is about to exit)
-        edge_health.last_error = f"{type(exc).__name__}: {str(exc)[:300]}"
-        get_registry().counter(
-            "tpudas_stream_errors_total",
-            "realtime driver crashes (recorded in health.json)",
-        ).inc()
-        edge_health.write(
-            counters, rounds, polls,
-            "stateful" if stateful else "rewind", 0.0, None,
-        )
-        raise
-    # clean termination: flush a deferred carry save (cadence > 1) so
-    # the next process resumes from the true head instead of replaying
-    # the last few rounds — crash paths skip this on purpose (a
-    # mid-increment carry may be ahead of the written outputs)
-    if stateful and carry is not None and carry_unsaved:
-        from tpudas.proc.stream import save_carry
-
-        save_carry(carry, output_folder)
-        carry_unsaved = 0
-    # final snapshot on clean termination: quarantine/degradation state
-    # from the LAST poll (a file can be quarantined by the very poll
-    # that terminates the loop) must be visible to the operator
-    edge_health.write(
-        counters, rounds, polls,
-        "stateful" if stateful else "rewind", round_rt, head_lag,
+    spec = StreamSpec(
+        stream_id=_shim_stream_id(output_folder),
+        source=source,
+        config=config,
+        output_folder=str(output_folder),
     )
-    return rounds
-
-
-# fresh patches processed per batched-rolling chunk: bounds the host
-# stack (a first poll over a large pre-existing archive makes EVERY
-# file fresh at once) while still amortizing the batched dispatch
-_ROLLING_BATCH_CHUNK = 32
+    runner = build_runner(spec, counters=counters, on_round=on_round)
+    return drive(runner, max_rounds=max_rounds, sleep_fn=sleep_fn)
 
 
 def run_rolling_realtime(
@@ -944,6 +282,7 @@ def run_rolling_realtime(
     pyramid=None,
     detect=None,
     detect_operators=None,
+    poll_jitter=None,
 ):
     """Poll ``source`` and rolling-mean each NEW patch (stateless per
     file — rolling_mean_dascore_edge.ipynb:209-221). Returns rounds
@@ -973,163 +312,34 @@ def run_rolling_realtime(
     ``TPUDAS_DETECT=1``, operators via ``detect_operators``) runs the
     :mod:`tpudas.detect` streaming operators over it.  Both hooks are
     crash-only, shed under disk pressure, and swallowed on failure.
+    ``poll_jitter`` stretches the poll cadence with the same
+    deterministic per-stream LCG as the low-pass driver.
     Note the rolling grid is anchored per file: for a globally uniform
     grid (what the pyramid and detect consumers assume) use a ``step``
     that divides the file duration.
     """
-    import os
-
-    from tpudas.core import units as _units
-    from tpudas.parallel.mesh import resolve_mesh
-
-    mesh = resolve_mesh(mesh)
-    if mesh is not None and "ch" not in mesh.shape:
-        raise ValueError(
-            "run_rolling_realtime mesh needs a 'ch' axis (use "
-            "tpudas.parallel.mesh.make_mesh); got axes "
-            f"{tuple(mesh.shape)}"
-        )
-    os.makedirs(output_folder, exist_ok=True)
-    _startup_audit(output_folder)
-    from tpudas.integrity import resource as _resource
-
-    interval = float(poll_interval) if poll_interval is not None else float(
-        file_duration
+    config = StreamConfig(
+        kind="rolling",
+        window=window,
+        step=step,
+        scale=scale,
+        distance=distance,
+        poll_interval=poll_interval,
+        file_duration=file_duration,
+        engine=engine,
+        mesh=mesh,
+        fault_policy=fault_policy,
+        quarantine=quarantine,
+        pyramid=pyramid,
+        detect=detect,
+        detect_operators=detect_operators,
+        poll_jitter=poll_jitter,
     )
-    policy = fault_policy if fault_policy is not None else RetryPolicy()
-    ledger = QuarantineLedger(output_folder) if quarantine else None
-    boundary = FaultBoundary(policy, ledger)
-    if pyramid is None:
-        pyramid = os.environ.get("TPUDAS_PYRAMID", "0") == "1"
-    pyramid = bool(pyramid)
-    if detect is None:
-        detect = os.environ.get("TPUDAS_DETECT", "0") == "1"
-    detect = bool(detect)
-    step_sec = _units.get_seconds(step)
-    pyr_state = {"store": None}  # cross-round open tile store (pyramid)
-    det_state = {"pipe": None}  # cross-round detect pipeline (detect)
-    initial_run = True
-    rounds = 0
-    polls = 0
-    # identify patches by their time span so a late-arriving file with
-    # an earlier timestamp is still processed (a positional high-water
-    # mark into the time-sorted spool would skip it silently)
-    processed: set = set()
-    while True:
-        polls += 1
-        try:
-            fault_point("round.body", poll=polls)
-            sp = boundary.begin_round(
-                make_spool(source).sort("time"), source
-            )
-            sub = (
-                sp.select(distance=distance) if distance is not None else sp
-            )
-            contents = sub.get_contents()
-            keys = [
-                (np.datetime64(a, "ns"), np.datetime64(b, "ns"))
-                for a, b in zip(contents["time_min"], contents["time_max"])
-            ]
-            fresh = [j for j, k in enumerate(keys) if k not in processed]
-            if not initial_run and not fresh and boundary.consecutive == 0:
-                print("No new data was detected. Real-time data processing ended successfully.")
-                break
-            if fresh:
-                rnd = rounds + 1
-                print("run number: ", rnd)
-                emitted_patches = []  # in-memory capture (pyramid/detect)
-
-                def write_out(j, out):
-                    out = out.new(data=np.asarray(out.data) * scale)
-                    fname = get_filename(
-                        out.attrs["time_min"], out.attrs["time_max"]
-                    )
-                    out.io.write(
-                        os.path.join(output_folder, fname), "dasdae"
-                    )
-                    processed.add(keys[j])
-                    if pyramid or detect:
-                        emitted_patches.append(out)
-
-                # bounded chunks: memory stays O(chunk), outputs are
-                # written as soon as they are computed
-                for c0 in range(0, len(fresh), _ROLLING_BATCH_CHUNK):
-                    chunk = fresh[c0 : c0 + _ROLLING_BATCH_CHUNK]
-                    outs = None
-                    if (
-                        mesh is not None
-                        and engine not in ("numpy", "host")
-                        and len(chunk) > 1
-                    ):
-                        from tpudas.ops.rolling import (
-                            rolling_mean_patches_batched,
-                        )
-
-                        patches = [sub[j] for j in chunk]
-                        outs = rolling_mean_patches_batched(
-                            mesh, patches, window, step
-                        )
-                        if outs is not None:
-                            log_event(
-                                "rolling_batched",
-                                patches=len(chunk),
-                                mesh=dict(mesh.shape),
-                            )
-                            for j, out in zip(chunk, outs):
-                                write_out(j, out)
-                    if outs is None:
-                        for j in chunk:
-                            print("working on patch ", j)
-                            write_out(
-                                j,
-                                sub[j]
-                                .rolling(
-                                    time=window, step=step, engine=engine
-                                )
-                                .mean(),
-                            )
-                # driver parity with run_lowpass_realtime: the same
-                # per-round serve/detect append hooks over the same
-                # in-memory emit capture
-                if pyramid and not _resource.should_shed("pyramid"):
-                    _append_pyramid(
-                        output_folder, rnd, emitted_patches, pyr_state
-                    )
-                if detect:
-                    from tpudas.detect.runner import (
-                        mark_detect_shed,
-                        run_detect_round,
-                    )
-
-                    if _resource.should_shed("detect"):
-                        mark_detect_shed(det_state)
-                    else:
-                        run_detect_round(
-                            output_folder, rnd, emitted_patches,
-                            det_state, operators=detect_operators,
-                            step_sec=step_sec,
-                        )
-                rounds = rnd
-            boundary.on_success()
-            if _resource.is_degraded():
-                _resource.probe_recovery(output_folder)
-            initial_run = False
-        except Exception as exc:
-            pyr_state["store"] = None
-            det_state["pipe"] = None
-            decision = boundary.on_failure(exc)
-            if decision.propagate:
-                raise
-            if max_rounds is not None and polls >= max_rounds:
-                break
-            with span(
-                "stream.retry",
-                kind=decision.kind,
-                attempt=boundary.consecutive,
-            ):
-                sleep_fn(decision.delay)
-            continue
-        if max_rounds is not None and polls >= max_rounds:
-            break
-        sleep_fn(interval)
-    return rounds
+    spec = StreamSpec(
+        stream_id=_shim_stream_id(output_folder),
+        source=source,
+        config=config,
+        output_folder=str(output_folder),
+    )
+    runner = build_runner(spec)
+    return drive(runner, max_rounds=max_rounds, sleep_fn=sleep_fn)

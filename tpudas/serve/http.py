@@ -1,4 +1,4 @@
-"""Zero-dependency HTTP server over one processed-output folder.
+"""Zero-dependency HTTP server over processed-output folders.
 
 ``ThreadingHTTPServer`` (stdlib, thread per connection) fronted by a
 **bounded admission gate**: at most ``max_inflight`` data-plane
@@ -6,8 +6,18 @@ requests execute at once, and a request that arrives with the gate
 full is shed IMMEDIATELY with ``503 + Retry-After`` instead of
 queueing behind a backlog it can only deepen (graceful degradation,
 the tpudas.resilience posture).  Control-plane endpoints
-(``/healthz``, ``/metrics``) bypass the gate — an operator must be
-able to see a saturated server's health *because* it is saturated.
+(``/healthz``, ``/metrics``, ``/fleet/healthz``) bypass the gate — an
+operator must be able to see a saturated server's health *because* it
+is saturated.
+
+One server fronts one folder, a whole fleet, or both (ISSUE 8): every
+data/health endpoint below is additionally mounted per stream at
+``/s/<stream_id>/...`` (``DASServer(streams={...})`` or
+``DASServer.for_fleet(root)``, which mounts every non-hidden
+``root/<stream_id>/`` directory), all streams share the ONE admission
+gate and process registry (``/metrics`` is the merged exposition by
+construction), and ``/fleet/healthz`` aggregates every stream's
+``health.json`` into one operator view.
 
 Endpoints (all GET):
 
@@ -29,7 +39,13 @@ Endpoints (all GET):
   source of truth; this is its live read path).
 - ``/metrics``   — the LIVE process registry in Prometheus text
   exposition (the ``metrics.prom`` file snapshot remains for the
-  node-exporter textfile collector).
+  node-exporter textfile collector).  Process-wide: in a fleet this
+  is already the merged view over every stream.
+- ``/s/<stream_id>/query`` (``/waterfall`` ``/events`` ``/healthz``)
+  — the same endpoints scoped to one mounted stream.
+- ``/fleet/healthz`` — aggregate health over every mounted stream:
+  per-stream status (``ok`` / ``degraded`` / ``unknown``), counts,
+  and an overall status that is ``ok`` only when every stream is.
 
 ``npy`` responses carry provenance headers (``X-Tpudas-Level``,
 ``X-Tpudas-Step-Ns``, ``X-Tpudas-Source``, ``X-Tpudas-T0-Ns``, ...);
@@ -69,8 +85,24 @@ _DEFAULT_EVENTS_LIMIT = 1000
 _DEFAULT_SCORES_LIMIT = 10000
 
 
-def _load_events_cached(server):
-    """The parsed + crc-verified ledger, cached on the server keyed by
+class _Mount:
+    """One mounted output folder: its query engine plus the per-mount
+    ledger/score-store caches.  The root mount serves the bare
+    endpoints; stream mounts serve ``/s/<stream_id>/...``."""
+
+    def __init__(self, folder, stream_id=None, cache_tiles=256,
+                 engine=None):
+        self.folder = str(folder)
+        self.stream_id = stream_id
+        self.engine = QueryEngine(
+            self.folder, cache_tiles=cache_tiles, engine=engine
+        )
+        self._events_cache = None
+        self._score_store_cache = None
+
+
+def _load_events_cached(mount):
+    """The parsed + crc-verified ledger, cached on the mount keyed by
     the primary file's ``(mtime_ns, size)`` — a dashboard polling
     ``/events`` every second must not re-read and re-verify the whole
     history per request (the tile cache's discipline; here a stat
@@ -79,22 +111,22 @@ def _load_events_cached(server):
     from tpudas.detect.ledger import ledger_path, load_events
 
     try:
-        st = os.stat(ledger_path(server.folder))
+        st = os.stat(ledger_path(mount.folder))
         key = (st.st_mtime_ns, st.st_size)
     except OSError:
         key = None
     if key is not None:
-        cached = getattr(server, "_events_cache", None)
+        cached = mount._events_cache
         if cached is not None and cached[0] == key:
             return cached[1]
-    events = load_events(server.folder)
+    events = load_events(mount.folder)
     if key is not None:
-        server._events_cache = (key, events)
+        mount._events_cache = (key, events)
     return events
 
 
-def _open_score_store_cached(server):
-    """``ScoreStore.open`` cached on the server keyed by the scores
+def _open_score_store_cached(mount):
+    """``ScoreStore.open`` cached on the mount keyed by the scores
     manifest's ``(mtime_ns, size)`` — every commit (and truncation)
     atomically rewrites the manifest, so a stat decides freshness the
     same way :func:`_load_events_cached` does for the ledger.  Raises
@@ -102,7 +134,7 @@ def _open_score_store_cached(server):
     from tpudas.detect.ledger import SCORES_MANIFEST, ScoreStore
 
     manifest = os.path.join(
-        ScoreStore.scores_dir(server.folder), SCORES_MANIFEST
+        ScoreStore.scores_dir(mount.folder), SCORES_MANIFEST
     )
     try:
         st = os.stat(manifest)
@@ -110,12 +142,12 @@ def _open_score_store_cached(server):
     except OSError:
         key = None
     if key is not None:
-        cached = getattr(server, "_score_store_cache", None)
+        cached = mount._score_store_cache
         if cached is not None and cached[0] == key:
             return cached[1]
-    store = ScoreStore.open(server.folder)
+    store = ScoreStore.open(mount.folder)
     if key is not None:
-        server._score_store_cache = (key, store)
+        mount._score_store_cache = (key, store)
     return store
 
 
@@ -204,9 +236,26 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(status, body, "application/json", headers)
 
     # -- routing -------------------------------------------------------
+    def _resolve_mount(self, path):
+        """(mount_or_None, endpoint, stream_id_or_None): strips the
+        ``/s/<stream_id>`` prefix to the mount it names.  ``mount``
+        is None for an unknown stream id (404) — and for bare
+        endpoints on a fleet-only server with no root folder."""
+        endpoint = path.rstrip("/") or "/"
+        if endpoint == "/fleet/healthz":
+            return None, endpoint, None
+        if endpoint.startswith("/s/"):
+            sid, _, rest = endpoint[3:].partition("/")
+            return (
+                self.server.mounts.get(sid),
+                "/" + rest.rstrip("/"),
+                sid,
+            )
+        return self.server.mount, endpoint, None
+
     def do_GET(self):  # noqa: N802 - stdlib handler contract
         parts = urllib.parse.urlsplit(self.path)
-        endpoint = parts.path.rstrip("/") or "/"
+        mount, endpoint, stream_id = self._resolve_mount(parts.path)
         reg = get_registry()
         t_start = time.perf_counter()
         status = 500
@@ -225,8 +274,13 @@ class _Handler(BaseHTTPRequestHandler):
             self._account(reg, endpoint, 503, t_start)
             return
         try:
-            with span("serve.request", endpoint=endpoint):
-                status = self._dispatch(endpoint, _params(parts.query))
+            with span(
+                "serve.request", endpoint=endpoint,
+                stream=stream_id or "",
+            ):
+                status = self._dispatch(
+                    mount, endpoint, _params(parts.query), stream_id
+                )
         except ValueError as exc:
             status = 400
             self._send_json(400, {"error": str(exc)[:300]})
@@ -262,23 +316,44 @@ class _Handler(BaseHTTPRequestHandler):
             labelnames=("endpoint",),
         ).observe(time.perf_counter() - t_start, endpoint=endpoint)
 
-    def _dispatch(self, endpoint: str, params: dict) -> int:
-        if endpoint == "/healthz":
-            return self._healthz()
-        if endpoint == "/metrics":
+    def _dispatch(
+        self, mount, endpoint: str, params: dict, stream_id=None
+    ) -> int:
+        if endpoint == "/fleet/healthz":
+            return self._fleet_healthz()
+        if endpoint == "/metrics" and stream_id is None:
+            # process-wide (in a fleet: already merged over streams)
             return self._metrics()
+        if stream_id is not None and mount is None:
+            self._send_json(
+                404,
+                {"error": f"unknown stream {stream_id!r}",
+                 "streams": sorted(self.server.mounts)},
+            )
+            return 404
+        if endpoint in (*_DATA_ENDPOINTS, "/healthz") and mount is None:
+            # fleet-only server, bare endpoint: point at the routes
+            self._send_json(
+                404,
+                {"error": "no root folder mounted; use "
+                          "/s/<stream_id>" + endpoint,
+                 "streams": sorted(self.server.mounts)},
+            )
+            return 404
+        if endpoint == "/healthz":
+            return self._healthz(mount)
         if endpoint == "/query":
-            return self._query(params, waterfall=False)
+            return self._query(mount, params, waterfall=False)
         if endpoint == "/waterfall":
-            return self._query(params, waterfall=True)
+            return self._query(mount, params, waterfall=True)
         if endpoint == "/events":
-            return self._events(params)
+            return self._events(mount, params)
         self._send_json(404, {"error": f"unknown endpoint {endpoint!r}"})
         return 404
 
     # -- control plane -------------------------------------------------
-    def _healthz(self) -> int:
-        payload = read_health(self.server.folder)
+    def _healthz(self, mount) -> int:
+        payload = read_health(mount.folder)
         if payload is None:
             self._send_json(
                 503,
@@ -292,6 +367,56 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_json(200, body)
         return 200
 
+    def _fleet_healthz(self) -> int:
+        """Aggregate health over every mounted stream: the fleet
+        operator's one-stop liveness view.  Overall status is ``ok``
+        only when every stream has a snapshot and none is degraded;
+        any degraded stream makes the fleet ``degraded``; a stream
+        with no snapshot yet reads ``unknown`` (and the fleet is
+        ``degraded`` rather than falsely green).  Always 200 when at
+        least one stream is mounted — a degraded fleet must still be
+        inspectable — and 503 with no mounts at all."""
+        mounts = self.server.mounts
+        if not mounts:
+            self._send_json(
+                503,
+                {"status": "unknown",
+                 "detail": "no streams mounted (fleet routes need "
+                           "DASServer(streams=...) or .for_fleet)"},
+            )
+            return 503
+        streams = {}
+        counts = {"ok": 0, "degraded": 0, "unknown": 0}
+        for sid in sorted(mounts):
+            payload = read_health(mounts[sid].folder)
+            if payload is None:
+                status = "unknown"
+                entry = {"status": status}
+            else:
+                status = (
+                    "degraded" if payload.get("degraded") else "ok"
+                )
+                entry = {
+                    "status": status,
+                    "rounds": payload.get("rounds"),
+                    "mode": payload.get("mode"),
+                    "realtime_factor": payload.get("realtime_factor"),
+                    "head_lag_seconds": payload.get("head_lag_seconds"),
+                    "quarantined_files": payload.get(
+                        "quarantined_files"
+                    ),
+                    "last_error": payload.get("last_error"),
+                    "written_at": payload.get("written_at"),
+                }
+            counts[status] += 1
+            streams[sid] = entry
+        overall = "ok" if counts["ok"] == len(streams) else "degraded"
+        self._send_json(
+            200,
+            {"status": overall, "streams": streams, "counts": counts},
+        )
+        return 200
+
     def _metrics(self) -> int:
         text = get_registry().to_prometheus()
         self._send(
@@ -300,7 +425,7 @@ class _Handler(BaseHTTPRequestHandler):
         return 200
 
     # -- data plane ----------------------------------------------------
-    def _events(self, params: dict) -> int:
+    def _events(self, mount, params: dict) -> int:
         """The detection query plane: integrity-verified ledger events
         (and optionally score rows) filtered by time/channel window,
         score floor, operator and kind."""
@@ -332,7 +457,7 @@ class _Handler(BaseHTTPRequestHandler):
                 f"scores_limit must be positive, got {scores_limit}"
             )
         with span("serve.events"):
-            events = _load_events_cached(self.server)
+            events = _load_events_cached(mount)
             total = len(events)
             picked = []
             # scan newest-first so the cap keeps the events happening
@@ -367,7 +492,7 @@ class _Handler(BaseHTTPRequestHandler):
             }
             if params.get("scores") == "1":
                 try:
-                    store = _open_score_store_cached(self.server)
+                    store = _open_score_store_cached(mount)
                 except Exception as exc:
                     # an unreconcilable score store (the fsck's reset
                     # case) must degrade the scores track, not fail a
@@ -414,7 +539,7 @@ class _Handler(BaseHTTPRequestHandler):
         )
         return 200
 
-    def _query(self, params: dict, waterfall: bool) -> int:
+    def _query(self, mount, params: dict, waterfall: bool) -> int:
         if "t0" not in params or "t1" not in params:
             raise ValueError("t0 and t1 query parameters are required")
         t0 = _parse_time(params["t0"])
@@ -438,7 +563,7 @@ class _Handler(BaseHTTPRequestHandler):
                 float(params["resolution"]) if "resolution" in params
                 else None
             )
-        result = self.server.engine.query(
+        result = mount.engine.query(
             t0, t1, distance=dist, resolution=resolution,
             max_samples=max_samples, agg=agg,
         )
@@ -493,11 +618,15 @@ class _Server(ThreadingHTTPServer):
     daemon_threads = True
     allow_reuse_address = True
 
-    def __init__(self, addr, folder, engine, gate):
-        self.folder = str(folder)
-        self.engine = engine
+    def __init__(self, addr, mount, mounts, gate):
+        self.mount = mount  # root _Mount or None (fleet-only server)
+        self.mounts = dict(mounts)  # stream_id -> _Mount
         self.gate = gate
         super().__init__(addr, _Handler)
+
+    @property
+    def folder(self):  # legacy accessor (pre-fleet single-folder API)
+        return None if self.mount is None else self.mount.folder
 
 
 class DASServer:
@@ -505,20 +634,60 @@ class DASServer:
 
     ``port=0`` binds an ephemeral port (tests); :attr:`base_url` gives
     the bound address either way.
+
+    ``folder`` mounts one output folder on the bare endpoints
+    (``/query``, ``/healthz``, ...); ``streams`` (a
+    ``{stream_id: folder}`` mapping) additionally mounts each stream
+    at ``/s/<stream_id>/...`` and enables ``/fleet/healthz``.  Either
+    may be omitted; :meth:`for_fleet` builds the ``streams`` mapping
+    from a fleet root's directory layout.  All mounts share one
+    admission gate and the one process registry.
     """
 
-    def __init__(self, folder, host="127.0.0.1", port=0,
+    def __init__(self, folder=None, host="127.0.0.1", port=0,
                  max_inflight=_DEFAULT_MAX_INFLIGHT, cache_tiles=256,
-                 engine=None):
-        self.folder = str(folder)
-        self.query_engine = QueryEngine(
-            self.folder, cache_tiles=cache_tiles, engine=engine
+                 engine=None, streams=None):
+        if folder is None and not streams:
+            raise ValueError(
+                "DASServer needs a folder, streams, or both"
+            )
+        self.folder = None if folder is None else str(folder)
+        mount = (
+            None if folder is None
+            else _Mount(folder, cache_tiles=cache_tiles, engine=engine)
         )
+        mounts = {}
+        for sid, sfolder in (streams or {}).items():
+            sid = str(sid)
+            mounts[sid] = _Mount(
+                sfolder, stream_id=sid, cache_tiles=cache_tiles,
+                engine=engine,
+            )
+        # legacy attribute: the root mount's engine (None on a
+        # fleet-only server); per-stream engines live on the mounts
+        self.query_engine = None if mount is None else mount.engine
+        self.mounts = mounts
         self._httpd = _Server(
-            (host, int(port)), self.folder, self.query_engine,
+            (host, int(port)), mount, mounts,
             _AdmissionGate(max_inflight),
         )
         self._thread = None
+
+    @classmethod
+    def for_fleet(cls, root, **kwargs):
+        """A server over a fleet root: every non-hidden subdirectory
+        is mounted as a stream at ``/s/<name>/...`` (the
+        ``FleetEngine`` layout — see FLEET.md).  ``folder=`` may be
+        passed through to also mount a root folder on the bare
+        endpoints."""
+        from tpudas.integrity.audit import fleet_stream_dirs
+
+        streams = dict(fleet_stream_dirs(root))
+        if not streams:
+            raise ValueError(
+                f"no stream folders found under fleet root {root!r}"
+            )
+        return cls(streams=streams, **kwargs)
 
     @property
     def address(self):
@@ -559,10 +728,23 @@ def start_server(folder, **kwargs) -> DASServer:
     return DASServer(folder, **kwargs).start()
 
 
-def serve_forever(folder, host="0.0.0.0", port=8000, **kwargs) -> None:
-    """Blocking operator entry point (Ctrl-C to stop)."""
-    server = DASServer(folder, host=host, port=port, **kwargs)
-    print(f"tpudas.serve listening on {server.base_url} over {folder}")
+def serve_forever(folder, host="0.0.0.0", port=8000, fleet=False,
+                  **kwargs) -> None:
+    """Blocking operator entry point (Ctrl-C to stop).  ``fleet=True``
+    treats ``folder`` as a fleet root and mounts every stream at
+    ``/s/<stream_id>/...`` (plus ``/fleet/healthz``)."""
+    if fleet:
+        server = DASServer.for_fleet(folder, host=host, port=port,
+                                     **kwargs)
+        print(
+            f"tpudas.serve listening on {server.base_url} over fleet "
+            f"root {folder} (streams: {', '.join(sorted(server.mounts))})"
+        )
+    else:
+        server = DASServer(folder, host=host, port=port, **kwargs)
+        print(
+            f"tpudas.serve listening on {server.base_url} over {folder}"
+        )
     try:
         server._httpd.serve_forever()
     except KeyboardInterrupt:
@@ -576,18 +758,26 @@ def main(argv=None) -> int:
 
     ap = argparse.ArgumentParser(
         description="Serve processed DAS output over HTTP "
-                    "(/query /waterfall /healthz /metrics)"
+                    "(/query /waterfall /healthz /metrics; with "
+                    "--fleet also /s/<stream>/... and /fleet/healthz)"
     )
-    ap.add_argument("folder", help="processed output folder")
+    ap.add_argument("folder",
+                    help="processed output folder (or, with --fleet, "
+                         "the fleet root whose subdirectories are the "
+                         "streams)")
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=8000)
     ap.add_argument("--max-inflight", type=int,
                     default=_DEFAULT_MAX_INFLIGHT)
     ap.add_argument("--cache-tiles", type=int, default=256)
+    ap.add_argument("--fleet", action="store_true",
+                    help="serve a fleet root: mount every "
+                         "<root>/<stream_id>/ at /s/<stream_id>/...")
     args = ap.parse_args(argv)
     serve_forever(
         args.folder, host=args.host, port=args.port,
         max_inflight=args.max_inflight, cache_tiles=args.cache_tiles,
+        fleet=args.fleet,
     )
     return 0
 
