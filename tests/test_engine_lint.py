@@ -1,0 +1,45 @@
+"""tools/check_engines.py wired into tier-1: every engine literal the
+dispatch layers accept (LFProc config, stream-step kernels, batch
+kernels) must appear in the test matrix — a selector that parses but
+is never exercised cannot land."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import check_engines  # noqa: E402
+
+
+def test_repo_is_clean():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "check_engines.py")],
+        capture_output=True,
+        text=True,
+        timeout=240,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "check_engines: OK" in proc.stdout
+
+
+def test_accepted_sets_cover_the_fused_family():
+    """The ISSUE-10 selector literals are part of the lint surface:
+    dropping one from the dispatch tables silently would also drop it
+    from the lint, so pin them here."""
+    sets = check_engines.accepted_literals()
+    assert "fused" in sets["LFProc._ENGINES"]
+    for name in ("fused", "fused-xla", "fused-pallas"):
+        assert name in sets["tpudas.ops.fir.STREAM_ENGINES"]
+
+
+def test_untested_literal_detected(tmp_path, monkeypatch):
+    """An accepted literal missing from the test sources is flagged."""
+    (tmp_path / "tests").mkdir()
+    (tmp_path / "tests" / "test_x.py").write_text(
+        'ENGINES = ["auto", "fft"]\n'
+    )
+    problems = check_engines.lint(str(tmp_path))
+    assert problems
+    assert any("cascade" in p for p in problems)

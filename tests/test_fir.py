@@ -215,9 +215,10 @@ class TestPallasKernel:
         """TPUDAS_PALLAS_MIN_ELEMS applies a measured crossover
         without a code edit (tools/retune_stage_ok.py's output)."""
         from tpudas.ops.fir import _pallas_stage_ok
-        from tpudas.ops.pallas_fir import _KB
+        from tpudas.ops.pallas_fir import kernel_quantum
 
-        k, R, n_ch, B = _KB, 8, 128, 6  # k*R*n_ch = 2**19: below 2**24
+        # k*R*n_ch = 2**19: below 2**24
+        k, R, n_ch, B = kernel_quantum(), 8, 128, 6
         monkeypatch.delenv("TPUDAS_PALLAS_MIN_ELEMS", raising=False)
         assert not _pallas_stage_ok(k, R, n_ch, B)
         monkeypatch.setenv("TPUDAS_PALLAS_MIN_ELEMS", str(1 << 19))

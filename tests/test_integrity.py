@@ -847,8 +847,26 @@ class TestCrashDrill:
         assert rep["detect_match"], rep
         assert rep["ok"]
 
+    def test_smoke_fused_mesh_drill(self):
+        """Tier-1 smoke of the fused-engine drill leg (ISSUE 10): a
+        seeded SIGKILL cycle with ``engine="fused"`` on the
+        channel-sharded path ends audit-clean and byte-identical to
+        its own uninterrupted control — the fused carry save/resume
+        cycle survives power cuts (the drill worker clears
+        TPUDAS_FUSED_MIN_ELEMS so the small stream really runs the
+        fused kernel)."""
+        from tools.crash_drill import run_drill
+
+        rep = run_drill(engine="fused", cycles=1, seed=7, mesh=4)
+        assert rep["engine"] == "fused"
+        assert rep["audit_clean"], rep
+        assert rep["outputs_match"], rep
+        assert rep["pyramid_match"], rep
+        assert rep["detect_match"], rep
+        assert rep["ok"]
+
     @pytest.mark.slow
-    @pytest.mark.parametrize("engine", ["cascade", "fft"])
+    @pytest.mark.parametrize("engine", ["cascade", "fft", "fused"])
     @pytest.mark.parametrize("mesh", [0, 4])
     def test_full_drill(self, engine, mesh):
         from tools.crash_drill import run_drill

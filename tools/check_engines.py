@@ -1,0 +1,97 @@
+"""Engine-matrix lint: every engine literal the dispatch accepts is
+exercised by the test suite.
+
+The engine surface grew three dispatch layers (LFProc config,
+stream-step kernels, batch kernels) and ISSUE 10 added the fused
+family — an engine literal that parses but is never tested is exactly
+how a selector rots (the ``TPUDAS_STREAM_PALLAS`` path shipped gated
+off for two PRs because nothing exercised it).  This lint closes the
+loop: it imports the accepted literal sets from the dispatch code
+itself (so a new literal is flagged the moment it lands) and requires
+each to appear as a quoted string somewhere under ``tests/`` — the
+test matrix must name every engine it claims to cover.
+
+Run from anywhere:
+
+    python tools/check_engines.py
+
+Exit code 0 = clean; 1 = violations (printed one per line).  Wired
+into tier-1 via tests/test_engine_lint.py.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+TESTS_DIR = "tests"
+
+
+def accepted_literals() -> dict:
+    """The engine literals each dispatch layer accepts, read from the
+    dispatch code itself (import, not regex — a rename breaks the
+    lint loudly instead of silently narrowing it)."""
+    from tpudas.ops.fir import BATCH_ENGINES, STREAM_ENGINES
+    from tpudas.proc.lfproc import LFProc
+
+    return {
+        "LFProc._ENGINES": tuple(LFProc._ENGINES),
+        "tpudas.ops.fir.STREAM_ENGINES": tuple(STREAM_ENGINES),
+        "tpudas.ops.fir.BATCH_ENGINES": tuple(BATCH_ENGINES),
+    }
+
+
+# the lint's own tier-1 wrapper quotes literals while testing the
+# LINT — counting those would make the check vacuously green
+EXCLUDE_TESTS = ("test_engine_lint.py",)
+
+
+def tested_literals(tests_root: str) -> set:
+    """Every quoted string literal appearing in the test sources —
+    the test matrix's vocabulary."""
+    seen = set()
+    lit = re.compile(r"['\"]([A-Za-z0-9_-]+)['\"]")
+    for dirpath, _dirs, files in os.walk(tests_root):
+        for fn in sorted(files):
+            if not fn.endswith(".py") or fn in EXCLUDE_TESTS:
+                continue
+            with open(os.path.join(dirpath, fn)) as fh:
+                seen.update(lit.findall(fh.read()))
+    return seen
+
+
+def lint(repo: str = REPO) -> list:
+    tests_root = os.path.join(repo, TESTS_DIR)
+    if not os.path.isdir(tests_root):
+        return [f"missing tests directory at {tests_root}"]
+    seen = tested_literals(tests_root)
+    problems = []
+    for source, literals in accepted_literals().items():
+        for name in literals:
+            if name not in seen:
+                problems.append(
+                    f"engine literal {name!r} (accepted by {source}) "
+                    f"never appears in {TESTS_DIR}/ — add it to the "
+                    "test matrix or remove it from the dispatch"
+                )
+    return problems
+
+
+def main(argv=None) -> int:
+    repo = (argv or [None])[1] if argv and len(argv) > 1 else REPO
+    problems = lint(repo)
+    for p in problems:
+        print(p)
+    if not problems:
+        n = sum(len(v) for v in accepted_literals().values())
+        print(f"check_engines: OK ({n} engine literals covered)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

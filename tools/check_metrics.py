@@ -94,6 +94,11 @@ REQUIRED_METRICS = (
     "tpudas_fleet_steps_total",
     "tpudas_fleet_step_seconds",
     "tpudas_fleet_sched_seconds_total",
+    # fused streaming kernel (PR 10): tools/kernel_bench.py reads
+    # these by name as the witness a measured round ran the fused path
+    # and as the HBM-traffic proxy
+    "tpudas_fir_fused_rounds_total",
+    "tpudas_fir_fused_intermediate_bytes_saved_total",
 )
 REQUIRED_SPANS = (
     "serve.request",
@@ -107,6 +112,7 @@ REQUIRED_SPANS = (
     "parallel.gather",
     "fleet.run",
     "fleet.step",
+    "fir.fused",
 )
 
 

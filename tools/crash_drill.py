@@ -52,6 +52,12 @@ CLI (the full acceptance drill — ``BENCH_pr05.json`` records a run):
         [--cycles 25] [--seed 0] [--engines cascade,fft] [--out PATH] \
         [--mesh 4]
 
+``--engines`` accepts any LFProc engine literal; ``fused`` (ISSUE 10)
+drills the fused streaming kernel — the worker clears the fused size
+threshold (``TPUDAS_FUSED_MIN_ELEMS=0``) so the tiny drill stream
+actually runs the fused path, and the control replay runs it too, so
+the byte-identity claim covers the fused carry save/resume cycle.
+
 ``--mesh N`` (ISSUE 7) channel-shards every drilled cycle over N
 CPU-virtualized devices (``TPUDAS_MESH`` resolution in the driver)
 while the control replay stays single-device: one run then proves
@@ -112,6 +118,12 @@ DETECT_OPS = (
 
 def _worker(src: str, out: str, engine: str) -> int:
     import time as _t
+
+    if engine == "fused":
+        # the drill stream is tiny (4 ch); drop the fused size
+        # threshold so the drilled path IS the fused kernel, not the
+        # per-stage fallback the crossover gate would pick
+        os.environ.setdefault("TPUDAS_FUSED_MIN_ELEMS", "0")
 
     from tpudas.proc.streaming import run_lowpass_realtime
 

@@ -862,6 +862,16 @@ class LowpassStreamRunner(StreamRunner):
             # patch_size only shapes chunking — honor the live setting
             # rather than the persisted one
             carry.patch_out = self.process_patch_size
+            # a COMPATIBLE engine change (carry_matches accepted it:
+            # the cascade <-> fused crossover shares the carry layout
+            # byte-for-byte) is honored live, mid-stream
+            live_engine = str(lfp.parameters["engine"])
+            if carry.engine_req != live_engine:
+                log_event(
+                    "stream_engine_crossover",
+                    was=carry.engine_req, now=live_engine,
+                )
+                carry.engine_req = live_engine
             reconcile_outputs(self.output_folder, carry)
             log_event("stream_resume", emitted=carry.emitted)
             self.edge_health.carry_resumes += 1

@@ -60,8 +60,55 @@ __all__ = [
     "edge_support_samples",
     "butter2_mag",
     "resolve_cascade_engine",
+    "resolve_stream_engine",
     "stage_engines",
+    "knob_fingerprint",
+    "fused_chunk_outputs",
+    "fused_intermediate_bytes",
+    "STREAM_ENGINES",
+    "BATCH_ENGINES",
 ]
+
+# engine literals the STREAM dispatch (cascade_decimate_stream)
+# accepts: the per-stage chain with its own pallas/xla routing, plus
+# the fused single-kernel formulations (ISSUE 10).  "fused" resolves
+# by backend + measured size threshold (resolve_stream_engine); the
+# -xla/-pallas spellings force a variant.  tools/check_engines.py
+# lints that every literal here appears in the test matrix.
+STREAM_ENGINES = ("auto", "pallas", "xla", "fused", "fused-xla",
+                  "fused-pallas")
+# engine literals the BATCH entry points (cascade_decimate & the
+# window/batched paths) accept — the fused formulation is
+# streaming-only (it exists to kill per-stage intermediates ACROSS
+# carried blocks; the batch path's windows are one-shot).
+BATCH_ENGINES = ("auto", "pallas", "xla")
+
+# every env knob that changes kernel geometry or engine selection.
+# knob_fingerprint() reads them at CALL time and every jit/layout
+# cache key below includes the fingerprint, so a retune
+# (tools/retune_stage_ok.py) applies mid-process — no restart, no
+# manual cache clear (the stale-knob footgun this replaces).
+_KNOB_ENVS = (
+    "TPUDAS_PALLAS_P",
+    "TPUDAS_PALLAS_CB",
+    "TPUDAS_PALLAS_IMPL",
+    "TPUDAS_PALLAS_MIN_ELEMS",
+    "TPUDAS_STREAM_PALLAS",
+    "TPUDAS_PALLAS_DIMSEM",
+    "TPUDAS_PALLAS_GRID",
+    "TPUDAS_PALLAS_VMEM_MB",
+    "TPUDAS_FUSED_CHUNK",
+    "TPUDAS_FUSED_MIN_ELEMS",
+)
+
+
+def knob_fingerprint() -> tuple:
+    """The current value of every geometry/selector env knob, as one
+    hashable tuple.  Threaded into every compiled-fn and layout cache
+    key so a mid-process knob change can never hit a stale entry."""
+    import os
+
+    return tuple(os.environ.get(n, "").strip() for n in _KNOB_ENVS)
 
 
 def butter2_mag(f, corner, order):
@@ -345,16 +392,17 @@ def _pallas_stage_ok(k: int, R: int, n_ch: int, n_frames: int) -> bool:
 
     ``TPUDAS_PALLAS_MIN_ELEMS`` overrides the element threshold so a
     measured crossover (``tools/retune_stage_ok.py``) can be applied
-    on a live chip without a code edit."""
+    on a live chip without a code edit — and without a process
+    restart: callers key their caches on :func:`knob_fingerprint`."""
     import os
 
-    from tpudas.ops.pallas_fir import _KB, _SB
+    from tpudas.ops.pallas_fir import _SB, kernel_quantum
 
     raw = os.environ.get("TPUDAS_PALLAS_MIN_ELEMS", "").strip()
     min_elems = int(raw) if raw else (1 << 24)
     return (
         k * R * n_ch >= min_elems
-        and k >= _KB
+        and k >= kernel_quantum()
         and n_frames <= _SB
     )
 
@@ -387,7 +435,9 @@ def chain_layout(
     shapes = tuple(
         (int(R), -(-len(h) // int(R))) for R, h in plan.stages
     )
-    return _layout_for(shapes, int(n_out), int(n_ch), engine)
+    return _layout_for(
+        shapes, int(n_out), int(n_ch), engine, knob_fingerprint()
+    )
 
 
 def stage_engines(
@@ -451,6 +501,7 @@ def _apply_cascade_stages(x, blocked, n_out, use_pallas, interpret,
         int(n_out),
         int(x.shape[1]),
         engine,
+        knob_fingerprint(),
     )
     first_pallas = layout[0][0] == "pallas" if layout else False
     quantized = qscale is not None and x.dtype == jnp.int16
@@ -475,9 +526,12 @@ def _apply_cascade_stages(x, blocked, n_out, use_pallas, interpret,
 
 
 @functools.lru_cache(maxsize=256)
-def _layout_for(stage_shapes, n_out, n_ch, engine):
+def _layout_for(stage_shapes, n_out, n_ch, engine, knobs=()):
     """chain_layout core on hashable (R, B) pairs: returns
-    ``(((engine_i, k_i), ...), rows)``."""
+    ``(((engine_i, k_i), ...), rows)``.  ``knobs`` is the env
+    fingerprint (:func:`knob_fingerprint`) — unused in the body (the
+    threshold/quantum reads go to the live env) but REQUIRED in the
+    cache key so a mid-process retune recomputes the layout."""
     from tpudas.ops.pallas_fir import stage_input_rows
 
     k = int(n_out)
@@ -499,10 +553,15 @@ def _blocked_taps(plan: CascadePlan):
 
 
 def _clear_cascade_caches():
-    """Drop every compiled-cascade cache (single-device, time-sharded,
-    window-batched) so the next call retraces — needed when the Pallas
-    implementation selector (TPUDAS_PALLAS_IMPL) changes mid-process."""
+    """Drop every compiled-cascade cache (single-device, streaming,
+    fused, time-sharded, window-batched) so the next call retraces.
+    Env knob changes no longer need this — every cache keys on
+    :func:`knob_fingerprint` — but benches/tests that monkeypatch
+    resolution functions themselves still do."""
     _build_cascade_fn.cache_clear()
+    _build_stream_cascade_fn.cache_clear()
+    _build_fused_stream_fn.cache_clear()
+    _layout_for.cache_clear()
     try:
         from tpudas.parallel.pipeline import _build_sharded_cascade_fn
 
@@ -527,7 +586,7 @@ def _pallas_interpret() -> bool:
 
 @functools.lru_cache(maxsize=64)
 def _build_cascade_fn(plan: CascadePlan, n_out: int, engine: str, mesh=None,
-                      ch_axis="ch", quantized=False):
+                      ch_axis="ch", quantized=False, knobs=()):
     """jit-compiled causal cascade: x (T, C) -> (n_out, C); with
     ``quantized`` the signature is (x_int16, scale) and the scale is a
     TRACED operand (the compile caches on the bool, not the value —
@@ -611,7 +670,8 @@ def cascade_decimate(
     args = (x2, jnp.float32(qscale)) if quantized else (x2,)
     if mesh is None:
         fn = _build_cascade_fn(
-            plan, int(n_out), engine, quantized=quantized
+            plan, int(n_out), engine, quantized=quantized,
+            knobs=knob_fingerprint(),
         )
         # dispatch-side timing (async backends sync at the caller's
         # np.asarray; the synced wall lands in window device metrics)
@@ -624,7 +684,7 @@ def cascade_decimate(
         x2 = jnp.pad(x2, ((0, 0), (0, pad_c)))
         args = (x2, *args[1:])
     fn = _build_cascade_fn(plan, int(n_out), engine, mesh, ch_axis,
-                           quantized=quantized)
+                           quantized=quantized, knobs=knob_fingerprint())
     out = fn(*args)
     return out[:, :C] if pad_c else out
 
@@ -732,17 +792,230 @@ def stream_stage_engines(plan: CascadePlan, T: int, n_ch: int,
                          engine: str = "auto") -> list:
     """Ground truth of which engine each stage runs under for a stream
     block of ``T`` rows — the streaming analogue of
-    :func:`stage_engines` (same observability contract)."""
-    engine = resolve_cascade_engine(engine)
+    :func:`stage_engines` (same observability contract).  Under a
+    fused variant every stage runs inside the one fused kernel, so
+    every entry is the variant name."""
+    engine = resolve_stream_engine(engine, plan, T, n_ch)
+    if engine.startswith("fused"):
+        return [engine for _ in plan.stages]
     return [
         "pallas" if u else "xla"
         for u in _stream_stage_pallas(plan, T, n_ch, engine)
     ]
 
 
+# ---------------------------------------------------------------------------
+# fused streaming (ISSUE 10): the whole cascade as ONE kernel.
+#
+# The per-stage stream step above materializes every stage's output in
+# HBM before the next stage consumes it — at 10k channels that is
+# ~T/R0 * C * 4 bytes written AND re-read per block for stage 1 alone.
+# The carry is an SSM-style O(1) autoregressive cache (PAPERS.md
+# "Compiler-First State Space Duality"), and the fused formulation
+# treats it as one: a single scan (XLA) or Pallas grid walk keeps
+# EVERY stage's trailing-sample state live across chunk steps and
+# emits only the final decimated output.  Per-stage intermediates
+# exist only at chunk granularity — sized to stay cache/VMEM-resident
+# — so the full-rate input is read once and nothing else at full rate
+# touches HBM.
+#
+# The carry pytree layout is IDENTICAL to the per-stage engines
+# (stream_carry_sizes), so a stream can cross between
+# cascade <-> fused mid-run (tests/test_fused.py pins resume in both
+# directions), and the fused-XLA scan replays the per-stage
+# arithmetic chunk-by-chunk — byte-identical outputs AND carry.
+
+
+def fused_min_elems() -> int:
+    """Block elements (T*C) below which a ``fused`` request falls back
+    to the per-stage chain: per-chunk scan/grid overheads dominate on
+    small blocks.  Default from the measured CPU crossover
+    (tools/retune_stage_ok.py --fused, PERF.md §11);
+    ``TPUDAS_FUSED_MIN_ELEMS`` applies a retune live (the dispatch
+    caches key on :func:`knob_fingerprint`)."""
+    import os
+
+    raw = os.environ.get("TPUDAS_FUSED_MIN_ELEMS", "").strip()
+    return int(raw) if raw else (1 << 23)
+
+
+def fused_chunk_outputs(plan: CascadePlan, n_out: int) -> int:
+    """Output samples per fused chunk step: the largest divisor of the
+    block's output count not exceeding the target
+    (``TPUDAS_FUSED_CHUNK``, default sized so one full-rate chunk is
+    ~8192 rows — small enough that every stage's chunk intermediate
+    stays cache/VMEM resident, large enough that per-chunk overhead
+    amortizes).  A divisor (not a remainder split) keeps the scan a
+    single static shape."""
+    import os
+
+    raw = os.environ.get("TPUDAS_FUSED_CHUNK", "").strip()
+    target = int(raw) if raw else max(1, 8192 // plan.ratio)
+    n_out = int(n_out)
+    target = max(1, min(target, n_out))
+    best = 1
+    for d in range(1, n_out + 1):
+        if d > target:
+            break
+        if n_out % d == 0:
+            best = d
+    return best
+
+
+def fused_intermediate_bytes(plan: CascadePlan, T: int, n_ch: int) -> int:
+    """HBM-traffic proxy: bytes of per-stage intermediates the
+    per-stage chain materializes for a ``(T, n_ch)`` block that the
+    fused formulation never writes (each is also re-READ by the next
+    stage, so the eliminated traffic is ~2x this)."""
+    rows = int(T)
+    total = 0
+    for R, _h in plan.stages[:-1]:
+        rows //= int(R)
+        total += rows * int(n_ch) * 4
+    return total
+
+
+def resolve_stream_engine(engine: str, plan: CascadePlan = None,
+                          T: int = 0, n_ch: int = 0) -> str:
+    """Resolve a stream-dispatch engine literal to what actually runs:
+    ``auto`` -> the per-stage chain with backend routing; ``fused`` ->
+    ``fused-pallas`` on TPU backends / ``fused-xla`` elsewhere when
+    the block clears :func:`fused_min_elems` and the plan fits the
+    kernel, else the per-stage chain (the measured-crossover
+    threshold, same contract as ``_pallas_stage_ok``); explicit
+    ``fused-xla``/``fused-pallas`` are forced."""
+    if engine not in STREAM_ENGINES:
+        raise ValueError(
+            f"stream engine must be one of {STREAM_ENGINES}, got "
+            f"{engine!r}"
+        )
+    if engine in ("auto", "pallas", "xla"):
+        return resolve_cascade_engine(engine)
+    if engine == "fused":
+        if plan is not None and int(T) * int(n_ch) < fused_min_elems():
+            return resolve_cascade_engine("auto")
+        import jax
+
+        engine = (
+            "fused-pallas"
+            if jax.default_backend() in ("tpu", "axon")
+            else "fused-xla"
+        )
+    if engine == "fused-pallas" and plan is not None:
+        from tpudas.ops.pallas_fir import fused_taps_fit
+
+        chunk = fused_chunk_outputs(
+            plan, max(int(T) // plan.ratio, 1)
+        )
+        if not fused_taps_fit(plan.stages, chunk):
+            return "fused-xla"
+    return engine
+
+
+@functools.lru_cache(maxsize=128)
+def _build_fused_stream_fn(plan: CascadePlan, T: int, n_ch: int,
+                           variant: str, mesh=None, ch_axis="ch",
+                           knobs=()):
+    """jit-compiled FUSED stateful step: (x (T, C), carry) ->
+    (y (T/ratio, C), new_carry) with every stage state threaded
+    through one program — no per-stage HBM intermediates.
+
+    ``variant`` is ``fused-xla`` (a ``lax.scan`` over chunk steps
+    whose body replays the per-stage polyphase arithmetic — chunk
+    intermediates live in the scan body, and outputs/carry are
+    byte-identical to the per-stage chain) or ``fused-pallas`` (the
+    pallas_fir v3 kernel: stage tails in VMEM scratch across the
+    block's grid steps).  Donation, mesh wrapping, and the sharded
+    carry contract mirror :func:`_build_stream_cascade_fn`; ``knobs``
+    keys the cache on the live env fingerprint."""
+    import jax
+    import jax.numpy as jnp
+
+    blocked = _blocked_taps(plan)
+    sizes = stream_carry_sizes(plan)
+    n_out_total = T // plan.ratio
+    chunk_out = fused_chunk_outputs(plan, n_out_total)
+    chunk_in = chunk_out * plan.ratio
+    n_steps = n_out_total // chunk_out
+
+    if variant == "fused-pallas":
+        from tpudas.ops.pallas_fir import fused_cascade_pallas
+
+        stages_np = tuple(
+            (int(R), np.asarray(h, np.float32)) for R, h in plan.stages
+        )
+        interpret = _pallas_interpret()
+
+        def fn(x, carry):
+            return fused_cascade_pallas(
+                x.astype(jnp.float32), tuple(carry), stages_np, sizes,
+                chunk_out, interpret=interpret,
+            )
+
+    else:
+
+        def step(bufs, xc):
+            y = xc
+            new = []
+            for (R, hb), p, buf in zip(blocked, sizes, bufs):
+                xi = jnp.concatenate([buf, y], axis=0) if p else y
+                k = y.shape[0] // R
+                new.append(xi[xi.shape[0] - p:])
+                y = _polyphase_stage_xla(xi, hb, R, k)
+            return tuple(new), y
+
+        def fn(x, carry):
+            x = x.astype(jnp.float32)
+            if n_steps <= 1:
+                bufs, y = step(tuple(carry), x)
+                return y, bufs
+            xs = x.reshape(n_steps, chunk_in, x.shape[1])
+            bufs, ys = jax.lax.scan(step, tuple(carry), xs)
+            return ys.reshape(n_out_total, x.shape[1]), bufs
+
+    body = fn
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+
+        from tpudas.parallel.compat import shard_map
+
+        spec = P(None, ch_axis)
+        carry_specs = tuple(spec for _ in sizes)
+        body = shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(spec, carry_specs),
+            out_specs=(spec, carry_specs),
+            check_vma=False,
+        )
+    donate = (0, 1) if jax.default_backend() not in ("cpu",) else ()
+    return jax.jit(body, donate_argnums=donate)
+
+
+def _count_fused(plan: CascadePlan, T: int, n_ch: int,
+                 variant: str) -> None:
+    """Per-dispatch fused-path observability: rounds by variant and
+    the HBM-traffic proxy (intermediate bytes the per-stage chain
+    would have materialized) — tools/kernel_bench.py reads both."""
+    from tpudas.obs.registry import get_registry
+
+    reg = get_registry()
+    reg.counter(
+        "tpudas_fir_fused_rounds_total",
+        "fused cascade stream steps dispatched",
+        labelnames=("engine",),
+    ).inc(engine=variant)
+    reg.counter(
+        "tpudas_fir_fused_intermediate_bytes_saved_total",
+        "per-stage full-rate HBM intermediate bytes the fused kernel "
+        "did not materialize",
+    ).inc(fused_intermediate_bytes(plan, T, n_ch))
+
+
 @functools.lru_cache(maxsize=128)
 def _build_stream_cascade_fn(plan: CascadePlan, T: int, n_ch: int,
-                             engine: str, mesh=None, ch_axis="ch"):
+                             engine: str, mesh=None, ch_axis="ch",
+                             knobs=()):
     """jit-compiled stateful step: (x (T, C), carry) -> (y (T/ratio, C),
     new_carry).  Both the input block and the carry are donated on
     accelerator backends — every buffer fed in is dead the moment the
@@ -825,12 +1098,27 @@ def cascade_decimate_stream(x, carry, plan: CascadePlan, engine="auto",
     host round-trip; ``y`` is trimmed to the logical channel count.
     The sharded step is byte-identical to the single-device step
     (channel columns are independent; tests/test_parallel.py pins it).
+
+    ``engine`` accepts every :data:`STREAM_ENGINES` literal: the
+    per-stage chain (``auto``/``pallas``/``xla``) or the fused
+    single-kernel formulations (``fused`` resolves by backend and the
+    measured size threshold; ``fused-xla``/``fused-pallas`` force a
+    variant).  The carry layout is shared, so the engine may change
+    between steps of one stream (cascade <-> fused crossover).
     """
     import jax.numpy as jnp
 
-    engine = resolve_cascade_engine(engine)
-    x = jnp.asarray(x) if mesh is None else x
     T = int(np.shape(x)[0])
+    n_ch = int(np.shape(x)[1])
+    # size thresholds see what one device actually traces: the LOCAL
+    # channel count under a mesh (same contract as _pallas_stage_ok)
+    n_ch_res = (
+        n_ch if mesh is None
+        else -(-n_ch // int(mesh.shape[ch_axis]))
+    )
+    engine = resolve_stream_engine(engine, plan, T, n_ch_res)
+    fused = engine.startswith("fused")
+    x = jnp.asarray(x) if mesh is None else x
     if T % plan.ratio:
         raise ValueError(
             f"stream block length {T} is not a multiple of the "
@@ -846,17 +1134,28 @@ def cascade_decimate_stream(x, carry, plan: CascadePlan, engine="auto",
         )
     from tpudas.obs.trace import span
 
+    knobs = knob_fingerprint()
     if mesh is None:
-        fn = _build_stream_cascade_fn(plan, T, int(x.shape[1]), engine)
-        with span("op.cascade_stream", rows=T, engine=engine):
-            return fn(x, tuple(jnp.asarray(b, jnp.float32) for b in carry))
+        if fused:
+            fn = _build_fused_stream_fn(plan, T, n_ch, engine,
+                                        knobs=knobs)
+            sp = span("fir.fused", rows=T, engine=engine)
+        else:
+            fn = _build_stream_cascade_fn(plan, T, n_ch, engine,
+                                          knobs=knobs)
+            sp = span("op.cascade_stream", rows=T, engine=engine)
+        with sp:
+            out = fn(x, tuple(jnp.asarray(b, jnp.float32) for b in carry))
+        if fused:
+            _count_fused(plan, T, n_ch, engine)
+        return out
     from tpudas.parallel.sharding import (
         channel_pad,
         place_block,
         place_carry_leaves,
     )
 
-    C = int(np.shape(x)[1])
+    C = n_ch
     Cp = C + channel_pad(C, mesh, ch_axis)
     if any(int(np.shape(b)[1]) not in (C, Cp) for b in carry):
         raise ValueError(
@@ -870,12 +1169,20 @@ def cascade_decimate_stream(x, carry, plan: CascadePlan, engine="auto",
         # the logical width — pad-and-place them once; every later
         # round feeds back the sharded leaves this step returns
         carry = place_carry_leaves(carry, mesh, ch_axis)
-    fn = _build_stream_cascade_fn(plan, T, Cp, engine, mesh, ch_axis)
-    with span(
-        "op.cascade_stream", rows=T, engine=engine,
-        shards=int(mesh.shape[ch_axis]),
-    ):
+    if fused:
+        fn = _build_fused_stream_fn(plan, T, Cp, engine, mesh, ch_axis,
+                                    knobs=knobs)
+        sp = span("fir.fused", rows=T, engine=engine,
+                  shards=int(mesh.shape[ch_axis]))
+    else:
+        fn = _build_stream_cascade_fn(plan, T, Cp, engine, mesh, ch_axis,
+                                      knobs=knobs)
+        sp = span("op.cascade_stream", rows=T, engine=engine,
+                  shards=int(mesh.shape[ch_axis]))
+    with sp:
         y, bufs = fn(xs, tuple(carry))
+    if fused:
+        _count_fused(plan, T, C, engine)
     return (y[:, :C] if Cp != C else y), bufs
 
 

@@ -55,7 +55,15 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["fir_decimate_pallas", "stage_input_rows"]
+__all__ = [
+    "fir_decimate_pallas",
+    "stage_input_rows",
+    "fused_cascade_pallas",
+    "fused_taps_fit",
+    "kernel_quantum",
+    "channel_block",
+    "pallas_p",
+]
 
 _SB = 128  # output frames per sub-block (one MXU dot)
 
@@ -80,11 +88,28 @@ def _env_geom(name: str, default: int, multiple_of: int = 1) -> int:
 
 
 # geometry is env-tunable so on-chip sweeps need no code edits; the
-# engine's chain layout reads the same constants, keeping the sizing
-# math and the kernel grid in lockstep
-_P = _env_geom("TPUDAS_PALLAS_P", 4)  # parallel DMA streams
-_KB = _SB * _P  # output frames per grid step (the grid quantum)
-_CB = _env_geom("TPUDAS_PALLAS_CB", 128, multiple_of=128)  # channel block
+# engine's chain layout calls the same accessors, keeping the sizing
+# math and the kernel grid in lockstep.  Read at CALL time (not
+# import) so a retune (tools/retune_stage_ok.py) applies mid-process:
+# every jit/layout cache that depends on these carries
+# ``tpudas.ops.fir.knob_fingerprint()`` in its key, so a changed env
+# value dispatches fresh instead of hitting a stale compile.
+
+
+def pallas_p() -> int:
+    """Parallel DMA streams per grid step (``TPUDAS_PALLAS_P``)."""
+    return _env_geom("TPUDAS_PALLAS_P", 4)
+
+
+def kernel_quantum() -> int:
+    """Output frames per grid step (the grid quantum): ``_SB`` frames
+    per parallel sub-block times :func:`pallas_p` sub-blocks."""
+    return _SB * pallas_p()
+
+
+def channel_block() -> int:
+    """Channel (lane) block size (``TPUDAS_PALLAS_CB``)."""
+    return _env_geom("TPUDAS_PALLAS_CB", 128, multiple_of=128)
 
 
 def _mosaic_knobs():
@@ -150,12 +175,13 @@ def _halo_frames(B: int, sb: int = _SB) -> int:
     return halo_f
 
 
-def stage_input_rows(B: int, R: int, n_out: int, kb: int = _KB) -> int:
+def stage_input_rows(B: int, R: int, n_out: int, kb: int | None = None) -> int:
     """Input rows this kernel consumes to emit ``n_out`` outputs with
     B tap-frames at stride R — the grid/halo-padded figure. Feeding
     exactly this many rows makes the kernel pad-free (the internal
     ``jnp.pad`` otherwise materializes a full copy of the input, which
     at engine scale is an extra HBM round-trip per stage)."""
+    kb = kernel_quantum() if kb is None else int(kb)
     sb = min(int(kb), _SB)
     return (_round_up(int(n_out), kb) + _halo_frames(B, sb)) * R
 
@@ -296,7 +322,7 @@ def _fir_decimate_pallas_v1(x, hb, R: int, n_out: int,
 
 
 def fir_decimate_pallas(
-    x, hb, R: int, n_out: int, interpret: bool = False, kb=_KB, cb=_CB
+    x, hb, R: int, n_out: int, interpret: bool = False, kb=None, cb=None
 ):
     """Strided FIR: x (T, C) f32 or int16, hb (B, R) f32 -> (n_out, C)
     f32.
@@ -325,7 +351,8 @@ def fir_decimate_pallas(
         return _fir_decimate_pallas_v1(x, hb, R, n_out, interpret)
     B = int(hb.shape[0])
     T, C = x.shape
-    KB, CB = int(kb), int(cb)
+    KB = kernel_quantum() if kb is None else int(kb)
+    CB = channel_block() if cb is None else int(cb)
     SB = min(KB, _SB)
     P = KB // SB
     if KB % SB:
@@ -403,3 +430,247 @@ def fir_decimate_pallas(
         **call_kwargs,
     )(A, *([x2] * P), x2)
     return out[:n_out, :C]
+
+
+# ---------------------------------------------------------------------------
+# v3: the FUSED cascade kernel (ISSUE 10).  One pallas_call runs the
+# whole multistage decimator: the grid walks (channel block, time
+# chunk); each grid step reads one full-rate input chunk, pushes it
+# through EVERY stage back to back inside VMEM, and writes only the
+# final decimated output chunk.  Each stage's trailing-sample state
+# lives in a VMEM scratch buffer that persists across the time-chunk
+# grid steps (initialized from the carry refs at t == 0, flushed to
+# the carry outputs every step so the last step's write is the new
+# carry) — zero per-stage full-rate intermediates ever reach HBM.
+#
+# Stage math is the v1 VPU formulation (exact f32 multiply-reduce, no
+# bf16 split): the per-stage work is ~B multiply-adds per input sample
+# and the fused kernel's DMA stream is ~R-times lighter than the
+# per-stage kernels' (input read once, decimated output only), so the
+# VPU-vs-MXU tradeoff of PERF.md §4 tilts back — the v2 MXU banded
+# matmul needed its arithmetic headroom to keep up with TWO full-rate
+# HBM streams per stage, which the fused kernel has eliminated.
+# Like v2 at its introduction, v3 has interpret-mode coverage here and
+# awaits Mosaic validation on silicon (PERF.md §5 protocol).
+#
+# Tail alignment trick: stage i carries p_i trailing input rows
+# (tpudas.ops.fir.stream_carry_sizes — p_i is NOT generally a
+# multiple of R_i, and the carry layout is shared byte-for-byte with
+# the unfused engines).  The scratch holds q_i = round_up(p_i, R_i)
+# rows — off_i = q_i - p_i extra OLDER rows whose values multiply
+# only against zero-padded taps — so the concatenated (q_i + chunk_i)
+# working block frame-blocks exactly into (q_i/R_i + k_i) tap frames
+# and the taps shift by off_i into hb'[b*R + r] = h[b*R + r - off_i].
+
+
+def _round_up_div(a: int, b: int) -> int:
+    return -(-int(a) // int(b))
+
+
+def fused_taps_fit(stages, chunk_out: int) -> bool:
+    """Whether :func:`fused_cascade_pallas` can run this plan at this
+    chunk size: every stage's chunk must be a whole number of frames
+    (guaranteed by construction) and the per-step VMEM footprint —
+    input chunk + all stage scratch + taps — must fit the ~16 MiB
+    budget with double-buffering headroom."""
+    cb = channel_block()
+    ratio = 1
+    for R, _h in stages:
+        ratio *= int(R)
+    chunk_in = int(chunk_out) * ratio
+    vmem = 2 * chunk_in * cb * 4  # double-buffered input block
+    rows = chunk_in
+    for R, h in stages:
+        p = max(len(h) - int(R), 0)
+        q = _round_up_div(p, int(R)) * int(R)
+        vmem += (q + rows) * cb * 4  # working block + scratch
+        rows //= int(R)
+    vmem += 2 * int(chunk_out) * cb * 4  # double-buffered output
+    return vmem <= 12 * 2**20
+
+
+def _fused_stage_meta(stages, sizes, chunk_in: int):
+    """Static per-stage geometry for the fused kernel: (R, k, p, q,
+    off, L, hbp) with hbp the off-shifted frame-blocked taps and L
+    the true tap length (the kernel SLICES the off/pad positions out
+    of the partial frames rather than multiplying by zero — 0 * NaN
+    would smear NaN outside the receptive field)."""
+    meta = []
+    rows = int(chunk_in)
+    for (R, h), p in zip(stages, sizes):
+        R = int(R)
+        h = np.asarray(h, np.float32)
+        p = int(p)
+        q = _round_up_div(p, R) * R
+        off = q - p
+        k = rows // R
+        bp = _round_up_div(off + len(h), R)
+        hbp = np.zeros((bp, R), np.float32)
+        hbp.reshape(-1)[off : off + len(h)] = h
+        meta.append((R, k, p, q, off, int(len(h)), hbp))
+        rows = k
+    return meta
+
+
+def _fused_kernel_body(meta, CB):
+    n_stage = len(meta)
+    n_state = sum(1 for _R, _k, p, _q, _off, _L, _h in meta if p)
+
+    def kernel(*refs):
+        taps = refs[:n_stage]
+        x_ref = refs[n_stage]
+        cin = refs[n_stage + 1 : n_stage + 1 + n_state]
+        y_ref = refs[n_stage + 1 + n_state]
+        cout = refs[n_stage + 2 + n_state : n_stage + 2 + 2 * n_state]
+        scr = refs[n_stage + 2 + 2 * n_state :]
+        t = pl.program_id(1)
+
+        @pl.when(t == 0)
+        def _init():
+            si = 0
+            for _R, _k, p, q, off, _L, _h in meta:
+                if not p:
+                    continue
+                if off:
+                    scr[si][:off] = jnp.zeros((off, CB), jnp.float32)
+                scr[si][off:] = cin[si][:]
+                si += 1
+
+        y = x_ref[:].astype(jnp.float32)
+        si = 0
+        for i, (R, k, p, q, off, L, hbp) in enumerate(meta):
+            if p:
+                z = jnp.concatenate([scr[si][:], y], axis=0)
+                if off:
+                    scr[si][:off] = jnp.zeros((off, CB), jnp.float32)
+                scr[si][off:] = z[z.shape[0] - p :]
+                cout[si][:] = z[z.shape[0] - p :]
+                si += 1
+            else:
+                z = y
+            zf = z.reshape(z.shape[0] // R, R, CB)
+            acc = jnp.zeros((k, CB), jnp.float32)
+            tv = taps[i][:]
+            for b in range(hbp.shape[0]):
+                # the partial first/last frames are SLICED to the true
+                # tap support [off, off + L): multiplying the padded
+                # positions by their zero taps instead would turn a
+                # NaN-gap row into 0 * NaN = NaN and smear NaN outside
+                # the receptive field (the per-stage polyphase path
+                # pays that smear only FORWARD; slicing keeps this
+                # kernel's NaN set a subset of the reference's)
+                lo = max(0, off - b * R)
+                hi = min(R, off + L - b * R)
+                if hi <= lo:
+                    continue
+                acc = acc + jnp.sum(
+                    zf[b : b + k, lo:hi] * tv[b, lo:hi][None, :, None],
+                    axis=1,
+                )
+            y = acc
+        y_ref[:] = y
+
+    return kernel
+
+
+def fused_cascade_pallas(
+    x, bufs, stages, sizes, chunk_out: int, interpret: bool = False,
+    cb=None,
+):
+    """One fused stateful cascade step: x (T, C) f32, ``bufs`` the
+    per-stage carry tuple ((p_i, C) each, the same layout every other
+    engine carries) -> (y (T/ratio, C), new_bufs).
+
+    ``T`` must be a multiple of ``chunk_out * ratio`` (the caller
+    picks ``chunk_out`` dividing the block's output count —
+    :func:`tpudas.ops.fir.fused_chunk_outputs`).  ``stages`` are the
+    plan's (R, taps) pairs with CONCRETE taps; ``x``/``bufs`` may be
+    traced.  Channel counts that are not lane-block multiples get
+    whole-block zero padding (carry columns included — zero columns
+    stay zero through the linear stages, so the trim is exact)."""
+    CB = channel_block() if cb is None else int(cb)
+    T, C = x.shape
+    ratio = 1
+    for R, _h in stages:
+        ratio *= int(R)
+    chunk_in = int(chunk_out) * ratio
+    if T % chunk_in:
+        raise ValueError(
+            f"fused kernel block ({T} rows) is not a multiple of the "
+            f"chunk ({chunk_in} rows)"
+        )
+    nt = T // chunk_in
+    nc = _round_up_div(C, CB)
+    pad_c = nc * CB - C
+    if pad_c:
+        x = jnp.pad(x, ((0, 0), (0, pad_c)))
+        bufs = tuple(jnp.pad(b, ((0, 0), (0, pad_c))) for b in bufs)
+    meta = _fused_stage_meta(stages, sizes, chunk_in)
+    state = [(i, q, p) for i, (_R, _k, p, q, _off, _L, _h) in
+             enumerate(meta) if p]
+
+    grid_spec = dict(
+        grid=(nc, nt),
+        in_specs=[
+            *[
+                pl.BlockSpec(
+                    tuple(hbp.shape), lambda c, t: (0, 0),
+                    memory_space=pltpu.VMEM,
+                )
+                for _R, _k, _p, _q, _off, _L, hbp in meta
+            ],
+            pl.BlockSpec(
+                (chunk_in, CB), lambda c, t: (t, c),
+                memory_space=pltpu.VMEM,
+            ),
+            *[
+                pl.BlockSpec(
+                    (p, CB), lambda c, t: (0, c),
+                    memory_space=pltpu.VMEM,
+                )
+                for _i, _q, p in state
+            ],
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (int(chunk_out), CB), lambda c, t: (t, c),
+                memory_space=pltpu.VMEM,
+            ),
+            *[
+                pl.BlockSpec(
+                    (p, CB), lambda c, t: (0, c),
+                    memory_space=pltpu.VMEM,
+                )
+                for _i, _q, p in state
+            ],
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T // ratio, nc * CB), jnp.float32),
+            *[
+                jax.ShapeDtypeStruct((p, nc * CB), jnp.float32)
+                for _i, _q, p in state
+            ],
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((q, CB), jnp.float32) for _i, q, _p in state
+        ],
+    )
+    outs = pl.pallas_call(
+        _fused_kernel_body(meta, CB),
+        interpret=interpret,
+        **grid_spec,
+    )(
+        *[jnp.asarray(hbp) for _R, _k, _p, _q, _off, _L, hbp in meta],
+        x.astype(jnp.float32),
+        *[bufs[i] for i, _q, _p in state],
+    )
+    y = outs[0][:, :C] if pad_c else outs[0]
+    new_tails = iter(outs[1:])
+    new_bufs = []
+    for i, b in enumerate(bufs):
+        if int(b.shape[0]):
+            nb = next(new_tails)
+            new_bufs.append(nb[:, :C] if pad_c else nb)
+        else:
+            new_bufs.append(b[:, :C] if pad_c else b)
+    return y, tuple(new_bufs)

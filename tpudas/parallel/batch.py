@@ -37,7 +37,7 @@ def batched_rolling_mean(mesh, batch, w: int, s: int, batch_axis="ch"):
 
 @functools.lru_cache(maxsize=64)
 def _build_batched_cascade_fn(
-    plan, n_out, engine, mesh, batch_axis, ch_axis, quantized
+    plan, n_out, engine, mesh, batch_axis, ch_axis, quantized, knobs=()
 ):
     from tpudas.parallel.compat import shard_map
 
@@ -117,9 +117,11 @@ def batched_cascade_decimate(
     pad_c = -C % nc
     if pad_w or pad_c:
         stack = jnp.pad(stack, ((0, pad_w), (0, 0), (0, pad_c)))
+    from tpudas.ops.fir import knob_fingerprint
+
     fn = _build_batched_cascade_fn(
         plan, int(n_out), engine, mesh, batch_axis, ch_axis,
-        qscale is not None,
+        qscale is not None, knobs=knob_fingerprint(),
     )
     if qscale is not None:
         out = fn(stack, jnp.float32(qscale))

@@ -107,7 +107,8 @@ def sharded_lowpass_decimate(
 
 @functools.lru_cache(maxsize=64)
 def _build_sharded_cascade_fn(
-    plan, n_loc, halo, engine, mesh, time_axis, ch_axis, quantized=False
+    plan, n_loc, halo, engine, mesh, time_axis, ch_axis, quantized=False,
+    knobs=(),
 ):
     """jit-compiled shard_map cascade: (nt*t_local, C) -> (nt*n_loc, C).
 
@@ -246,9 +247,11 @@ def sharded_cascade_decimate(
     pad_c = -C % nc
     if pad_c:
         x2 = jnp.pad(x2, ((0, 0), (0, pad_c)))
+    from tpudas.ops.fir import knob_fingerprint
+
     fn = _build_sharded_cascade_fn(
         plan, n_loc, halo, engine, mesh, time_axis, ch_axis,
-        quantized=qscale is not None,
+        quantized=qscale is not None, knobs=knob_fingerprint(),
     )
     if qscale is not None:
         out = fn(x2, jnp.float32(qscale))

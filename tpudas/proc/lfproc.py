@@ -266,7 +266,9 @@ class LFProc:
         # without enabling the log handler): a cascade window counts as
         # "cascade-pallas" when any of its stages ran the Pallas kernel,
         # "cascade-xla" otherwise; FFT-path windows count as "fft"
-        self.engine_counts = {"cascade-pallas": 0, "cascade-xla": 0, "fft": 0}
+        self.engine_counts = {"cascade-pallas": 0, "cascade-xla": 0,
+                              "fused-pallas": 0, "fused-xla": 0,
+                              "fft": 0}
         # cumulative per-phase wall seconds (SURVEY.md §5 tracing row:
         # "device-time breakdown per window"): assemble = waiting on
         # the prefetch thread's window read, device = kernel dispatch
@@ -338,7 +340,10 @@ class LFProc:
             # "auto": multistage polyphase FIR cascade (tpudas.ops.fir,
             # Pallas on TPU) when the target grid is sample-aligned and
             # the ratio factors; FFT engine otherwise. "fft"/"cascade"
-            # force one path.
+            # force one path. "fused" = cascade whose STREAM path runs
+            # the fused single-kernel formulation (ISSUE 10: all stage
+            # states resident, no per-stage HBM intermediates); batch
+            # windows under "fused" run the ordinary cascade.
             "engine": "auto",
             # window-level DATA parallelism (BASELINE "spool chunks
             # pmapped"): with a mesh whose "time" axis has size > 1,
@@ -351,7 +356,7 @@ class LFProc:
             "window_dp": False,
         }
 
-    _ENGINES = ("auto", "fft", "cascade")
+    _ENGINES = ("auto", "fft", "cascade", "fused")
     _GAP_MODES = ("raise", "skip", "split")
 
     # mesh execution ----------------------------------------------------
@@ -748,7 +753,9 @@ class LFProc:
         with its batch — or ``None`` when the window needs the full
         per-window path (FFT-aligned grids, undersized halos, engine
         config 'fft')."""
-        if self._para.get("engine", "auto") not in ("auto", "cascade"):
+        if self._para.get("engine", "auto") not in (
+            "auto", "cascade", "fused"
+        ):
             return None
         if target_times.size == 0:
             return None
@@ -1099,13 +1106,13 @@ class LFProc:
                 f"engine must be one of {self._ENGINES}, got {engine!r}"
             )
         align = None
-        if engine in ("auto", "cascade"):
+        if engine in ("auto", "cascade", "fused"):
             align = self._cascade_alignment(taxis, target_times, d_sec, dt)
-            if align is None and engine == "cascade":
+            if align is None and engine in ("cascade", "fused"):
                 raise ValueError(
-                    "engine='cascade' requires the output grid to land on "
-                    "input samples with an integer small-prime decimation "
-                    "ratio; use engine='auto' or 'fft'"
+                    f"engine={engine!r} requires the output grid to land "
+                    "on input samples with an integer small-prime "
+                    "decimation ratio; use engine='auto' or 'fft'"
                 )
         if align is not None:
             from tpudas.ops.fir import (
@@ -1131,7 +1138,7 @@ class LFProc:
                     phase=phase,
                     tail=int(tail),
                 )
-                if engine == "cascade":
+                if engine in ("cascade", "fused"):
                     print(
                         "Warning: edge_buff_size halo is smaller than the "
                         f"cascade filter support ({supp} input samples); "

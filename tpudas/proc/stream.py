@@ -95,7 +95,7 @@ class StreamCarry:
     dt_out: float  # output_sample_interval seconds
     buff_out: int  # edge_buff_size (output samples discarded cold)
     order: int
-    engine_req: str  # "auto" | "cascade" | "fft"
+    engine_req: str  # "auto" | "cascade" | "fft" | "fused"
     patch_out: int  # process_patch_size (stream chunk sizing)
     # engine state (None/zero until the stream sees data)
     kind: str | None = None  # "cascade" | "fft"
@@ -381,6 +381,27 @@ def open_stream(lfp, start_time) -> StreamCarry:
     )
 
 
+# engine requests that share the cascade carry layout byte-for-byte:
+# a stream may cross between them mid-run (resume a "cascade" carry
+# under "fused" and vice versa — ISSUE 10) because the per-stage
+# trailing-sample pytree is identical.  "fft" stays exclusive: its
+# overlap-save carry is a different object.
+_CASCADE_FAMILY = ("auto", "cascade", "fused")
+
+
+def _engines_compatible(old: str, new: str, kind) -> bool:
+    """Whether a carry produced under engine request ``old`` may
+    resume under ``new``.  Within the cascade family any crossover is
+    allowed unless the carry already opened the FFT engine (possible
+    only under ``old == "auto"``) — a cascade-only request cannot
+    continue an FFT carry."""
+    if old == new:
+        return True
+    if old in _CASCADE_FAMILY and new in _CASCADE_FAMILY:
+        return kind != "fft" or new == "auto"
+    return False
+
+
 def carry_matches(carry: StreamCarry, lfp, start_time=None) -> bool:
     """Resume guard: the loaded carry must have been produced by the
     same output-grid/filter/engine configuration — and, when
@@ -388,7 +409,10 @@ def carry_matches(carry: StreamCarry, lfp, start_time=None) -> bool:
     cannot be honored by a continuing grid; the caller raises so the
     operator deletes the carry instead of being silently ignored).
     ``process_patch_size`` is NOT compared: it only shapes chunking,
-    and the caller refreshes it from the live parameters."""
+    and the caller refreshes it from the live parameters — likewise a
+    compatible ``engine`` change (:func:`_engines_compatible`: the
+    cascade <-> fused crossover) is honored by refreshing
+    ``carry.engine_req``, not rejected."""
     para = lfp.parameters
     from tpudas.core.timeutils import quantize_step
 
@@ -409,7 +433,9 @@ def carry_matches(carry: StreamCarry, lfp, start_time=None) -> bool:
         carry.step_ns == step_ns
         and carry.buff_out == int(para["edge_buff_size"])
         and carry.order == int(para["filter_order"])
-        and carry.engine_req == str(para["engine"])
+        and _engines_compatible(
+            carry.engine_req, str(para["engine"]), carry.kind
+        )
     )
 
 
@@ -585,11 +611,11 @@ def _open_engine(lfp, carry: StreamCarry, host, t_ns, d_sec) -> int:
             aligned = False
     if carry.engine_req == "fft":
         aligned = False
-    if not aligned and carry.engine_req == "cascade":
+    if not aligned and carry.engine_req in ("cascade", "fused"):
         raise ValueError(
-            "engine='cascade' requires the output grid to land on "
-            "input samples with an integer small-prime decimation "
-            "ratio; use engine='auto' or 'fft'"
+            f"engine={carry.engine_req!r} requires the output grid to "
+            "land on input samples with an integer small-prime "
+            "decimation ratio; use engine='auto' or 'fft'"
         )
     carry.d_ns = d_ns
     carry.n_ch = n_ch
@@ -743,7 +769,15 @@ def _consume_cascade(lfp, carry: StreamCarry, patch, new) -> None:
     mesh = _stream_mesh(lfp)
     pool = _pool_with_residual(carry, new)
     usable = pool.shape[0] - pool.shape[0] % carry.ratio
-    eng_req = "auto" if (lfp._pallas_ok and carry.pallas_ok) else "xla"
+    pallas_ok = lfp._pallas_ok and carry.pallas_ok
+    if carry.engine_req == "fused":
+        # the fused selector: fused-pallas on TPU / fused-xla
+        # elsewhere, per-stage chain below the measured size
+        # threshold (tpudas.ops.fir.resolve_stream_engine); a latched
+        # Pallas failure forces the scan formulation
+        eng_req = "fused" if pallas_ok else "fused-xla"
+    else:
+        eng_req = "auto" if pallas_ok else "xla"
     # engine thresholds see what one device actually traces: the LOCAL
     # (padded) channel count under a mesh
     n_ch_eng = (
@@ -757,13 +791,16 @@ def _consume_cascade(lfp, carry: StreamCarry, patch, new) -> None:
         stages = stream_stage_engines(
             plan, blk.shape[0], n_ch_eng, eng_req
         )
-        ran = "cascade-pallas" if "pallas" in stages else "cascade-xla"
+        if stages and stages[0].startswith("fused"):
+            ran = stages[0]
+        else:
+            ran = "cascade-pallas" if "pallas" in stages else "cascade-xla"
         # the stream step donates the carry on accelerators, so a
         # fallback retry must not reuse buffers the failed dispatch
         # already consumed — snapshot them first (Pallas blocks only)
         backup = (
             tuple(np.asarray(b) for b in carry.bufs)
-            if ran == "cascade-pallas"
+            if ran.endswith("pallas")
             else None
         )
         t0 = time.perf_counter()
@@ -773,19 +810,21 @@ def _consume_cascade(lfp, carry: StreamCarry, patch, new) -> None:
             )
         except Exception as exc:
             # mirror the batch path's Pallas resilience: a fast-path
-            # failure degrades to the XLA formulation for the rest of
-            # the run instead of killing the stream
-            if ran != "cascade-pallas":
+            # failure degrades to the XLA formulation (fused scan for
+            # a fused stream) for the rest of the run instead of
+            # killing the stream
+            if not ran.endswith("pallas"):
                 raise
+            fb = "fused-xla" if ran == "fused-pallas" else "xla"
             print(
                 "Warning: Pallas kernel failed in the stream path "
-                f"({str(exc)[:120]}); falling back to the XLA cascade"
+                f"({str(exc)[:120]}); falling back to {fb}"
             )
             log_event("stream_pallas_fallback", error=str(exc)[:300])
             lfp._pallas_ok = False
             carry.pallas_ok = False  # persists across rounds/restarts
-            eng_req = "xla"
-            ran = "cascade-xla"
+            eng_req = fb
+            ran = "cascade-xla" if fb == "xla" else fb
             y, bufs = cascade_decimate_stream(
                 blk, backup, plan, eng_req, mesh=mesh
             )
