@@ -1,0 +1,682 @@
+"""tpudas.codec + the compressed serve stack (ISSUE 11).
+
+Covers the acceptance set: codec roundtrip property tests (lossless
+byte-exact, lossy within its ``max_error`` bound, NaN-gap blocks,
+empty/partial tiles), the compressed tile store (chunked == one-shot
+== raw for lossless codecs, deterministic lossy builds, crashed-append
+resume, mixed raw+compressed stores, ``TPUDAS_CODEC``), HTTP caching
+(strong ETags, conditional GET/304, ``Cache-Control: immutable`` on
+full-tile windows, ``Accept-Encoding`` negotiation, the ``/tile``
+endpoint), byte-identical ``/query``/``/waterfall`` responses between
+a compressed and a raw store, fsck repair of torn compressed tiles,
+and the SO_REUSEPORT worker pool's shared data port + merged control
+plane.
+"""
+
+import glob
+import io
+import json
+import os
+import shutil
+import urllib.error
+import urllib.request
+import zlib
+
+import numpy as np
+import pytest
+
+from tpudas.codec import (
+    CodecError,
+    codec_ids,
+    decode_tile,
+    encode_tile,
+    get_codec,
+    parse_codec_spec,
+    read_tile_header,
+    verify_tile_blob,
+)
+from tpudas.core.timeutils import to_datetime64
+from tpudas.integrity.audit import audit
+from tpudas.io.registry import write_patch
+from tpudas.obs.registry import MetricsRegistry, use_registry
+from tpudas.serve.query import QueryEngine
+from tpudas.serve.tiles import TileStore, rebuild_pyramid, sync_pyramid
+from tpudas.testing import synthetic_patch
+
+T0 = "2023-03-22T00:00:00"
+LOSSLESS = tuple(c for c in codec_ids() if get_codec(c).lossless)
+LOSSY = tuple(c for c in codec_ids() if not get_codec(c).lossless)
+
+# the roundtrip matrix's shape vocabulary: a full level-0 tile, a
+# coarse (3, rows, ch) aggregate stack, a partial tile, a single row,
+# and the empty tile
+SHAPES = [(64, 16), (3, 32, 8), (5, 3), (1, 7), (0, 4)]
+
+
+def _grid(n):
+    t0 = to_datetime64(T0).astype("datetime64[ns]")
+    return t0 + np.arange(n) * np.timedelta64(1, "s")
+
+
+def _tile_data(shape, seed, nan_block=True):
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal(shape).astype(np.float32)
+    if nan_block and a.size:
+        a.flat[:: max(a.size // 7, 1)] = np.nan
+    return a
+
+
+class TestCodecRoundtrip:
+    @pytest.mark.parametrize("codec", LOSSLESS)
+    @pytest.mark.parametrize("shape", SHAPES)
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_lossless_byte_exact(self, codec, shape, seed):
+        a = _tile_data(shape, seed)
+        blob = encode_tile(a, codec)
+        assert verify_tile_blob(blob) == "ok"
+        d = decode_tile(blob)
+        assert d.dtype == a.dtype and d.shape == a.shape
+        assert d.tobytes() == a.tobytes()
+
+    @pytest.mark.parametrize("codec", LOSSLESS)
+    def test_lossless_int_dtypes(self, codec):
+        rng = np.random.default_rng(3)
+        for dtype in (np.int16, np.int32, np.float64):
+            a = rng.integers(-1000, 1000, (33, 9)).astype(dtype)
+            assert decode_tile(encode_tile(a, codec)).tobytes() == (
+                a.tobytes()
+            )
+
+    @pytest.mark.parametrize("codec", LOSSY)
+    @pytest.mark.parametrize("max_error", [1e-1, 1e-3, 1e-5])
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_lossy_within_bound(self, codec, max_error, seed):
+        a = _tile_data((48, 12), seed)
+        blob = encode_tile(a, codec, max_error=max_error)
+        d = decode_tile(blob)
+        assert d.dtype == a.dtype and d.shape == a.shape
+        # NaN gaps survive EXACTLY — gap honesty is not negotiable
+        assert (np.isnan(d) == np.isnan(a)).all()
+        fin = np.isfinite(a)
+        assert np.abs(d[fin] - a[fin]).max() <= max_error
+
+    @pytest.mark.parametrize("codec", LOSSY)
+    def test_lossy_edge_tiles(self, codec):
+        # all-NaN block (a pure data gap) and the empty tile
+        gap = np.full((16, 4), np.nan, np.float32)
+        d = decode_tile(encode_tile(gap, codec, max_error=1e-3))
+        assert np.isnan(d).all() and d.shape == gap.shape
+        empty = np.empty((0, 4), np.float32)
+        d = decode_tile(encode_tile(empty, codec, max_error=1e-3))
+        assert d.shape == (0, 4)
+
+    def test_lossy_inf_conditions_to_nan(self):
+        """condition() and encode() agree on non-finite values: inf
+        maps to NaN in BOTH, so conditioned rows roundtrip exactly
+        (an inf that conditioned to inf would decode to NaN and
+        break tails-vs-tile byte identity)."""
+        codec = get_codec("quantize-deflate")
+        a = np.array(
+            [[1.0, np.inf], [-np.inf, np.nan]], np.float32
+        )
+        conditioned = codec.condition(a, max_error=1e-2)
+        assert np.isnan(conditioned[0, 1])
+        assert np.isnan(conditioned[1, 0])
+        d = decode_tile(
+            encode_tile(conditioned, "quantize-deflate",
+                        max_error=1e-2)
+        )
+        assert d.tobytes() == conditioned.tobytes()
+
+    def test_lossy_rejects_unresolvable_grid(self):
+        # a bound finer than float32 resolution at the data magnitude
+        # cannot be honored — refuse, never silently violate it
+        a = np.full((4, 4), 3.0e7, np.float32)
+        with pytest.raises(CodecError, match="resolution"):
+            encode_tile(a, "quantize-deflate", max_error=1e-7)
+
+    def test_header_self_describes(self):
+        a = _tile_data((10, 3), 0)
+        hdr = read_tile_header(
+            encode_tile(a, "quantize-deflate", max_error=1e-2)
+        )
+        assert hdr["codec"] == "quantize-deflate"
+        assert hdr["shape"] == [10, 3]
+        assert hdr["params"]["max_error"] == 1e-2
+        assert hdr["raw_nbytes"] == a.nbytes
+
+    def test_tamper_and_truncation_detected(self):
+        blob = bytearray(encode_tile(_tile_data((32, 8), 1), "deflate"))
+        flipped = bytearray(blob)
+        flipped[-3] ^= 0xFF
+        assert verify_tile_blob(bytes(flipped)) == "torn"
+        with pytest.raises(CodecError):
+            decode_tile(bytes(flipped))
+        assert verify_tile_blob(bytes(blob[:6])) == "corrupt"
+        assert verify_tile_blob(b"not a tile at all") == "corrupt"
+
+    def test_spec_parsing(self):
+        assert parse_codec_spec(None) == (None, {})
+        assert parse_codec_spec("raw") == (None, {})
+        cid, params = parse_codec_spec(
+            "quantize-deflate:max_error=1e-3,level=9"
+        )
+        assert cid == "quantize-deflate"
+        assert params == {"max_error": 1e-3, "level": 9}
+        with pytest.raises(CodecError):
+            parse_codec_spec("no-such-codec")
+        with pytest.raises(ValueError):
+            parse_codec_spec("deflate:levelnine")
+
+
+class TestCompressedStore:
+    def _fill(self, folder, codec, chunks=(7, 13, 1, 29, 50)):
+        rng = np.random.default_rng(11)
+        data = rng.standard_normal((100, 5)).astype(np.float32)
+        data[30:40] = np.nan  # an interior gap
+        times = _grid(100)
+        store = TileStore.create(
+            folder, factor=4, tile_len=8, codec=codec
+        )
+        pos = 0
+        for chunk in chunks:
+            store.append(
+                times[pos : pos + chunk], data[pos : pos + chunk]
+            )
+            pos += chunk
+        return data
+
+    def _arrays(self, folder):
+        store = TileStore.open(folder)
+        return {
+            (lvl, agg): store.read(lvl, 0, store.n(lvl), agg=agg)
+            for lvl in range(store.n_levels)
+            for agg in ("mean", "min", "max")
+        }
+
+    @pytest.mark.parametrize("codec", LOSSLESS)
+    def test_lossless_store_equals_raw(self, tmp_path, codec):
+        """Chunked compressed == one-shot compressed == raw store,
+        byte for byte, across every level and aggregate."""
+        data = self._fill(str(tmp_path / "c"), codec)
+        self._fill(str(tmp_path / "raw"), None, chunks=(100,))
+        self._fill(str(tmp_path / "c1"), codec, chunks=(100,))
+        raw = self._arrays(str(tmp_path / "raw"))
+        chunked = self._arrays(str(tmp_path / "c"))
+        oneshot = self._arrays(str(tmp_path / "c1"))
+        assert raw.keys() == chunked.keys() == oneshot.keys()
+        for key in raw:
+            assert raw[key].tobytes() == chunked[key].tobytes(), key
+            assert raw[key].tobytes() == oneshot[key].tobytes(), key
+        np.testing.assert_array_equal(
+            chunked[(0, "mean")], data
+        )
+        # the store really is compressed on disk
+        assert glob.glob(str(tmp_path / "c" / ".tiles" / "L0" / "*.tpt"))
+        assert not glob.glob(
+            str(tmp_path / "c" / ".tiles" / "L0" / "*.npy")
+        )
+
+    def test_lossy_store_deterministic_and_bounded(self, tmp_path):
+        spec = "quantize-deflate:max_error=1e-2"
+        data = self._fill(str(tmp_path / "a"), spec)
+        self._fill(str(tmp_path / "b"), spec, chunks=(100,))
+        a, b = self._arrays(str(tmp_path / "a")), self._arrays(
+            str(tmp_path / "b")
+        )
+        for key in a:
+            assert a[key].tobytes() == b[key].tobytes(), key
+        lv0 = a[(0, "mean")]
+        assert (np.isnan(lv0) == np.isnan(data)).all()
+        fin = np.isfinite(data)
+        assert np.abs(lv0[fin] - data[fin]).max() <= 1e-2
+
+    def test_manifest_records_codec_and_params(self, tmp_path):
+        self._fill(
+            str(tmp_path), "quantize-deflate:max_error=1e-2,level=9"
+        )
+        store = TileStore.open(str(tmp_path))
+        assert store.codec == "quantize-deflate"
+        assert store.codec_params == {"max_error": 1e-2, "level": 9}
+        with open(store.manifest_path) as fh:
+            raw = json.load(fh)
+        assert raw["codec"] == "quantize-deflate"
+
+    def test_raw_store_manifest_unchanged(self, tmp_path):
+        """A raw store writes the exact pre-codec manifest schema —
+        old readers keep working on new raw stores."""
+        self._fill(str(tmp_path), None)
+        with open(TileStore.open(str(tmp_path)).manifest_path) as fh:
+            raw = json.load(fh)
+        assert "codec" not in raw and "generation" not in raw
+
+    def test_mixed_store_reads(self, tmp_path):
+        """A store with SOME tiles still raw (a half-converted or
+        half-upgraded tree) serves every tile, byte-identical."""
+        data = self._fill(str(tmp_path), "bitshuffle-deflate")
+        store = TileStore.open(str(tmp_path))
+        # hand-convert one completed tile back to raw .npy
+        blob_path = store.tile_blob_path(0, 1)
+        arr = decode_tile(open(blob_path, "rb").read())
+        from tpudas.integrity.checksum import write_npy_checksummed
+
+        write_npy_checksummed(store.tile_path(0, 1), arr)
+        os.remove(blob_path)
+        reread = TileStore.open(str(tmp_path)).read(0, 0, 100)
+        np.testing.assert_array_equal(reread, data)
+
+    def test_crashed_append_resume_byte_identity(self, tmp_path):
+        """The test_serve crashed-append scenario under a codec:
+        tiles advanced on disk, manifest did not; resume slices the
+        surplus invisible and re-appending converges byte-identically
+        with an uninterrupted oracle."""
+        rng = np.random.default_rng(5)
+        data = rng.standard_normal((12, 2)).astype(np.float32)
+        times = _grid(12)
+        store = TileStore.create(
+            str(tmp_path / "x"), factor=4, tile_len=8,
+            codec="bitshuffle-deflate",
+        )
+        store.append(times[:6], data[:6])
+        manifest_before = open(store.manifest_path).read()
+        store.append(times[6:], data[6:])
+        with open(store.manifest_path, "w") as fh:
+            fh.write(manifest_before)
+        resumed = TileStore.open(str(tmp_path / "x"))
+        assert resumed.levels[0] == 6
+        np.testing.assert_array_equal(resumed.read(0, 0, 6), data[:6])
+        resumed.append(times[6:], data[6:])
+        oracle = TileStore.create(
+            str(tmp_path / "y"), factor=4, tile_len=8,
+            codec="bitshuffle-deflate",
+        )
+        oracle.append(times, data)
+        for lvl in range(len(oracle.levels)):
+            assert (
+                resumed.read(lvl, 0, resumed.n(lvl)).tobytes()
+                == oracle.read(lvl, 0, oracle.n(lvl)).tobytes()
+            )
+
+    def test_unknown_manifest_codec_degrades(self, tmp_path):
+        """A manifest naming a codec this build does not know reads
+        as no-pyramid (the ladder), not a crash."""
+        self._fill(str(tmp_path), "deflate")
+        store = TileStore.open(str(tmp_path))
+        with open(store.manifest_path) as fh:
+            raw = json.load(fh)
+        raw["codec"] = "futuristic-zstd"
+        from tpudas.integrity.checksum import write_json_checksummed
+
+        write_json_checksummed(store.manifest_path, raw)
+        os.remove(store.manifest_path + ".prev")
+        assert TileStore.open(str(tmp_path)) is None
+
+
+def _write_outputs(folder, n_files=2, n_ch=4, seconds=20):
+    os.makedirs(folder, exist_ok=True)
+    t0 = to_datetime64(T0).astype("datetime64[ns]")
+    for i in range(n_files):
+        p = synthetic_patch(
+            t0=t0 + np.timedelta64(i * seconds, "s"),
+            duration=float(seconds), fs=1.0, n_ch=n_ch, seed=i,
+        )
+        write_patch(p, os.path.join(folder, f"LFDAS_{i:04d}.h5"))
+
+
+class TestSyncRebuildCodec:
+    def test_env_codec_applies_to_fresh_pyramid(self, tmp_path,
+                                                monkeypatch):
+        out = str(tmp_path / "out")
+        _write_outputs(out)
+        monkeypatch.setenv(
+            "TPUDAS_CODEC", "quantize-deflate:max_error=1e-3"
+        )
+        rows = sync_pyramid(out, tile_len=8)
+        assert rows == 40
+        store = TileStore.open(out)
+        assert store.codec == "quantize-deflate"
+        assert store.codec_params["max_error"] == 1e-3
+        # existing manifest wins over a changed env next sync
+        monkeypatch.setenv("TPUDAS_CODEC", "deflate")
+        sync_pyramid(out)
+        assert TileStore.open(out).codec == "quantize-deflate"
+
+    def test_rebuild_reencodes_and_bumps_generation(self, tmp_path):
+        out = str(tmp_path / "out")
+        _write_outputs(out)
+        sync_pyramid(out, tile_len=8)  # raw build
+        raw_store = TileStore.open(out)
+        oracle = raw_store.read(0, 0, raw_store.n(0))
+        assert raw_store.codec is None and raw_store.generation == 0
+        rows = rebuild_pyramid(out, codec="bitshuffle-deflate")
+        assert rows == 40
+        store = TileStore.open(out)
+        assert store.codec == "bitshuffle-deflate"
+        assert store.generation == 1
+        assert glob.glob(os.path.join(out, ".tiles", "L0", "*.tpt"))
+        # lossless re-encode is content-identical
+        np.testing.assert_array_equal(
+            store.read(0, 0, store.n(0)), oracle
+        )
+        # rebuild with the default preserves the recorded codec
+        rebuild_pyramid(out)
+        store = TileStore.open(out)
+        assert store.codec == "bitshuffle-deflate"
+        assert store.generation == 2
+        # ... and "raw" strips it
+        rebuild_pyramid(out, codec="raw")
+        store = TileStore.open(out)
+        assert store.codec is None and store.generation == 3
+
+    def test_reencode_invalidates_decoded_cache(self, tmp_path):
+        """The ISSUE-11 LRU fix: a held QueryEngine must not serve
+        pre-rebuild decoded arrays after a lossy re-encode (cache
+        keys carry the manifest generation + codec)."""
+        out = str(tmp_path / "out")
+        _write_outputs(out)
+        sync_pyramid(out, tile_len=8)
+        eng = QueryEngine(out)
+        store = eng.store
+        lo = np.datetime64(store.t0_ns, "ns")
+        hi = np.datetime64(store.head_ns - store.step_ns, "ns")
+        before = eng.query(lo, hi).data.copy()
+        # coarse lossy re-encode: content genuinely changes
+        rebuild_pyramid(out, codec="quantize-deflate:max_error=0.5")
+        after = eng.query(lo, hi).data
+        assert after.tobytes() != before.tobytes()
+        fin = np.isfinite(before)
+        assert np.abs(after[fin] - before[fin]).max() <= 0.5
+
+
+class TestFsckCodec:
+    def test_torn_compressed_tile_rebuilt(self, tmp_path):
+        out = str(tmp_path / "out")
+        _write_outputs(out)
+        sync_pyramid(out, tile_len=8, codec="bitshuffle-deflate")
+        store = TileStore.open(out)
+        oracle = {
+            (lvl, agg): store.read(lvl, 0, store.n(lvl), agg=agg)
+            .tobytes()
+            for lvl in range(store.n_levels)
+            for agg in ("mean", "min", "max")
+        }
+        tiles = sorted(
+            glob.glob(os.path.join(out, ".tiles", "L0", "*.tpt"))
+        )
+        with open(tiles[0], "r+b") as fh:
+            fh.seek(-4, 2)
+            fh.write(b"\x00\x00\x00\x00")
+        assert verify_tile_blob(open(tiles[0], "rb").read()) == "torn"
+        report = audit(out)
+        assert report["clean"]
+        assert any(
+            i["action"] == "rebuilt_pyramid" for i in report["issues"]
+        )
+        second = audit(out)
+        assert second["clean"] and not second["issues"]
+        rebuilt = TileStore.open(out)
+        assert rebuilt.codec == "bitshuffle-deflate"  # format survived
+        for (lvl, agg), want in oracle.items():
+            got = rebuilt.read(lvl, 0, rebuilt.n(lvl), agg=agg)
+            assert got.tobytes() == want, (lvl, agg)
+
+    def test_orphan_compressed_tile_removed(self, tmp_path):
+        out = str(tmp_path / "out")
+        _write_outputs(out)
+        sync_pyramid(out, tile_len=8, codec="deflate")
+        store = TileStore.open(out)
+        orphan = store.tile_blob_path(0, 40)
+        with open(orphan, "wb") as fh:
+            fh.write(b"TPTC garbage beyond the manifest head")
+        report = audit(out)
+        assert report["clean"]
+        assert any(
+            i["status"] == "orphan" and i["action"] == "removed"
+            for i in report["issues"]
+        )
+        assert not os.path.isfile(orphan)
+
+
+@pytest.fixture
+def twin_stores(tmp_path):
+    """The same output files under a raw and a (lossless) compressed
+    pyramid — the byte-identity acceptance pair."""
+    raw = str(tmp_path / "raw")
+    comp = str(tmp_path / "comp")
+    _write_outputs(raw, n_files=3)
+    shutil.copytree(raw, comp)
+    sync_pyramid(raw, tile_len=8)
+    sync_pyramid(comp, tile_len=8, codec="bitshuffle-deflate")
+    return raw, comp
+
+
+class TestHTTPCaching:
+    def _get(self, url, headers=None):
+        req = urllib.request.Request(url, headers=headers or {})
+        return urllib.request.urlopen(req, timeout=30)
+
+    def test_compressed_store_http_byte_identity(self, twin_stores):
+        """/query and /waterfall over a lossless compressed store are
+        byte-identical to the raw store's responses."""
+        from tpudas.serve.http import start_server
+
+        raw, comp = twin_stores
+        store = TileStore.open(raw)
+        t0s = str(np.datetime64(store.t0_ns, "ns"))
+        t1s = str(np.datetime64(store.head_ns - store.step_ns, "ns"))
+        tails = (
+            f"/query?t0={t0s}&t1={t1s}",
+            f"/query?t0={t0s}&t1={t1s}&format=json",
+            f"/waterfall?t0={t0s}&t1={t1s}&max_px=8",
+        )
+        with start_server(raw) as a, start_server(comp) as b:
+            for tail in tails:
+                ra = self._get(a.base_url + tail)
+                rb = self._get(b.base_url + tail)
+                assert ra.read() == rb.read(), tail
+                assert (
+                    ra.headers["X-Tpudas-Source"]
+                    == rb.headers["X-Tpudas-Source"]
+                )
+
+    def test_etag_304_and_cache_control(self, twin_stores):
+        from tpudas.serve.http import start_server
+
+        _, comp = twin_stores
+        store = TileStore.open(comp)
+        t0 = store.t0_ns
+        step = store.step_ns
+        with start_server(comp) as srv:
+            # inside completed full tiles -> immutable + strong ETag
+            url = (
+                f"{srv.base_url}/query?"
+                f"t0={np.datetime64(t0, 'ns')}"
+                f"&t1={np.datetime64(t0 + 7 * step, 'ns')}"
+            )
+            r = self._get(url)
+            assert r.headers["Cache-Control"] == (
+                "public, max-age=31536000, immutable"
+            )
+            etag = r.headers["ETag"]
+            assert etag.startswith('"')
+            with pytest.raises(urllib.error.HTTPError) as err:
+                self._get(url, headers={"If-None-Match": etag})
+            assert err.value.code == 304
+            assert err.value.read() == b""
+            assert err.value.headers["ETag"] == etag
+            # touching the growing head -> must revalidate at origin
+            head = self._get(
+                f"{srv.base_url}/query?"
+                f"t0={np.datetime64(t0, 'ns')}"
+                f"&t1={np.datetime64(store.head_ns, 'ns')}"
+            )
+            assert head.headers["Cache-Control"] == "no-cache"
+
+    def test_deflate_q0_is_refusal(self, twin_stores):
+        from tpudas.serve.http import start_server
+
+        _, comp = twin_stores
+        store = TileStore.open(comp)
+        url_tail = (
+            f"/query?t0={np.datetime64(store.t0_ns, 'ns')}"
+            f"&t1={np.datetime64(store.head_ns - store.step_ns, 'ns')}"
+        )
+        with start_server(comp) as srv:
+            r = self._get(
+                srv.base_url + url_tail,
+                headers={"Accept-Encoding": "gzip, deflate;q=0"},
+            )
+            assert r.headers.get("Content-Encoding") is None
+
+    def test_events_etag_and_no_cache(self, twin_stores):
+        """/events is origin-only but ETag-revalidatable: a polling
+        dashboard's unchanged ledger costs headers, not payload."""
+        from tpudas.serve.http import start_server
+
+        _, comp = twin_stores
+        with start_server(comp) as srv:
+            r = self._get(srv.base_url + "/events")
+            assert r.headers["Cache-Control"] == "no-cache"
+            etag = r.headers["ETag"]
+            with pytest.raises(urllib.error.HTTPError) as err:
+                self._get(
+                    srv.base_url + "/events",
+                    headers={"If-None-Match": etag},
+                )
+            assert err.value.code == 304
+
+    def test_torn_tile_never_served_immutable(self, twin_stores):
+        """A tile that fails its crc must 500, not be handed to a
+        CDN with a year-long immutable header."""
+        from tpudas.serve.http import start_server
+
+        _, comp = twin_stores
+        store = TileStore.open(comp)
+        path = store.tile_blob_path(0, 0)
+        with open(path, "r+b") as fh:
+            fh.seek(-4, 2)
+            fh.write(b"\x00\x00\x00\x00")
+        with start_server(comp) as srv:
+            for hdrs in ({}, {"Accept-Encoding": "x-tpt"}):
+                with pytest.raises(urllib.error.HTTPError) as err:
+                    self._get(
+                        f"{srv.base_url}/tile?level=0&idx=0",
+                        headers=hdrs,
+                    )
+                assert err.value.code == 500
+
+    def test_deflate_negotiation(self, twin_stores):
+        from tpudas.serve.http import start_server
+
+        _, comp = twin_stores
+        store = TileStore.open(comp)
+        url_tail = (
+            f"/query?t0={np.datetime64(store.t0_ns, 'ns')}"
+            f"&t1={np.datetime64(store.head_ns - store.step_ns, 'ns')}"
+        )
+        with start_server(comp) as srv:
+            plain = self._get(srv.base_url + url_tail)
+            body = plain.read()
+            assert plain.headers.get("Content-Encoding") is None
+            assert plain.headers["Vary"] == "Accept-Encoding"
+            enc = self._get(
+                srv.base_url + url_tail,
+                headers={"Accept-Encoding": "deflate"},
+            )
+            assert enc.headers["Content-Encoding"] == "deflate"
+            assert zlib.decompress(enc.read()) == body
+
+    def test_tile_endpoint(self, twin_stores):
+        from tpudas.serve.http import start_server
+
+        _, comp = twin_stores
+        store = TileStore.open(comp)
+        full_tiles = store.n(0) // store.tile_len
+        with start_server(comp) as srv:
+            # full tile: immutable npy by default
+            r = self._get(f"{srv.base_url}/tile?level=0&idx=0")
+            assert r.headers["Cache-Control"] == (
+                "public, max-age=31536000, immutable"
+            )
+            assert r.headers["X-Tpudas-Codec"] == "bitshuffle-deflate"
+            arr = np.load(io.BytesIO(r.read()))
+            np.testing.assert_array_equal(
+                arr, store.read(0, 0, store.tile_len)
+            )
+            # negotiated: the stored blob verbatim
+            r = self._get(
+                f"{srv.base_url}/tile?level=0&idx=0",
+                headers={"Accept-Encoding": "x-tpt"},
+            )
+            assert r.headers["Content-Encoding"] == "x-tpt"
+            blob = r.read()
+            assert verify_tile_blob(blob) == "ok"
+            np.testing.assert_array_equal(decode_tile(blob), arr)
+            # 304 on the blob representation too
+            with pytest.raises(urllib.error.HTTPError) as err:
+                self._get(
+                    f"{srv.base_url}/tile?level=0&idx=0",
+                    headers={"Accept-Encoding": "x-tpt",
+                             "If-None-Match": r.headers["ETag"]},
+                )
+            assert err.value.code == 304
+            # the partial head tile: origin-only
+            if store.n(0) % store.tile_len:
+                r = self._get(
+                    f"{srv.base_url}/tile?level=0&idx={full_tiles}"
+                )
+                assert r.headers["Cache-Control"] == "no-cache"
+            # beyond the head: 404 with the level map
+            with pytest.raises(urllib.error.HTTPError) as err:
+                self._get(f"{srv.base_url}/tile?level=0&idx=10000")
+            assert err.value.code == 404
+            # bad params: 400
+            with pytest.raises(urllib.error.HTTPError) as err:
+                self._get(f"{srv.base_url}/tile?level=0")
+            assert err.value.code == 400
+
+
+class TestServePool:
+    def test_merge_prometheus_labels(self):
+        from tpudas.serve.pool import merge_prometheus
+
+        merged = merge_prometheus({
+            "0": "# TYPE m counter\nm 1\nn{a=\"b\"} 2\n",
+            "1": "# TYPE m counter\nm 3\n",
+        })
+        lines = merged.splitlines()
+        assert lines.count("# TYPE m counter") == 1
+        assert 'm{worker="0"} 1' in lines
+        assert 'n{worker="0",a="b"} 2' in lines
+        assert 'm{worker="1"} 3' in lines
+
+    def test_pool_shared_port_and_control_plane(self, twin_stores):
+        from tpudas.serve.pool import ServePool, has_reuse_port
+
+        if not has_reuse_port():
+            pytest.skip("SO_REUSEPORT unavailable on this platform")
+        _, comp = twin_stores
+        store = TileStore.open(comp)
+        t0s = str(np.datetime64(store.t0_ns, "ns"))
+        t1s = str(np.datetime64(store.head_ns - store.step_ns, "ns"))
+        with ServePool(comp, port=0, workers=2) as pool:
+            url = f"{pool.base_url}/query?t0={t0s}&t1={t1s}"
+            bodies = {
+                urllib.request.urlopen(url, timeout=30).read()
+                for _ in range(8)
+            }
+            assert len(bodies) == 1  # every worker serves the bytes
+            health = json.loads(
+                urllib.request.urlopen(
+                    pool.control_url + "/healthz", timeout=30
+                ).read()
+            )
+            assert health["status"] == "ok"
+            assert len(health["workers"]) == 2
+            metrics = urllib.request.urlopen(
+                pool.control_url + "/metrics", timeout=30
+            ).read().decode()
+            assert 'worker="0"' in metrics
+            assert 'worker="1"' in metrics
+            assert 'worker="pool"' in metrics
+            assert "tpudas_serve_requests_total" in metrics

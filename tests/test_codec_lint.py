@@ -1,0 +1,44 @@
+"""tools/check_codecs.py wired into tier-1: every codec id the
+registry accepts must appear in the roundtrip test matrix — a tile
+format that registers but is never round-tripped in tests would be
+first READ during an incident."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import check_codecs  # noqa: E402
+
+
+def test_repo_is_clean():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "check_codecs.py")],
+        capture_output=True,
+        text=True,
+        timeout=240,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "check_codecs: OK" in proc.stdout
+
+
+def test_registry_covers_the_issue11_family():
+    """The shipped codec set is part of the lint surface: silently
+    unregistering one would also silently shrink the lint, so pin the
+    ids here."""
+    ids = check_codecs.registered_ids()
+    for cid in ("deflate", "bitshuffle-deflate", "quantize-deflate"):
+        assert cid in ids
+
+
+def test_untested_codec_detected(tmp_path):
+    """A registered id missing from the test sources is flagged."""
+    (tmp_path / "tests").mkdir()
+    (tmp_path / "tests" / "test_x.py").write_text(
+        'CODECS = ["deflate"]\n'
+    )
+    problems = check_codecs.lint(str(tmp_path))
+    assert problems
+    assert any("quantize-deflate" in p for p in problems)

@@ -1,0 +1,90 @@
+"""Codec-matrix lint: every registered tile codec is exercised by the
+test suite.
+
+ISSUE 11 made the tile format pluggable (:mod:`tpudas.codec`): a
+codec id that registers but is never round-tripped in tests is
+exactly how a format rots — its tiles would be written in production
+and first *read* during an incident.  Same pattern as
+``tools/check_engines.py``: the accepted id set is imported from the
+registry itself (a new codec is flagged the moment it registers) and
+each id must appear as a quoted string somewhere under ``tests/`` —
+the roundtrip test matrix must name every codec it claims to cover.
+
+Run from anywhere:
+
+    python tools/check_codecs.py
+
+Exit code 0 = clean; 1 = violations (printed one per line).  Wired
+into tier-1 via tests/test_codec_lint.py.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+TESTS_DIR = "tests"
+
+# the lint's own tier-1 wrapper quotes ids while testing the LINT —
+# counting those would make the check vacuously green
+EXCLUDE_TESTS = ("test_codec_lint.py",)
+
+
+def registered_ids() -> tuple:
+    """The codec ids the registry accepts, read from the registry
+    itself (import, not regex — a rename breaks the lint loudly)."""
+    from tpudas.codec import codec_ids
+
+    return codec_ids()
+
+
+def tested_literals(tests_root: str) -> set:
+    """Every quoted string literal appearing in the test sources —
+    the test matrix's vocabulary."""
+    seen = set()
+    lit = re.compile(r"['\"]([A-Za-z0-9_-]+)['\"]")
+    for dirpath, _dirs, files in os.walk(tests_root):
+        for fn in sorted(files):
+            if not fn.endswith(".py") or fn in EXCLUDE_TESTS:
+                continue
+            with open(os.path.join(dirpath, fn)) as fh:
+                seen.update(lit.findall(fh.read()))
+    return seen
+
+
+def lint(repo: str = REPO) -> list:
+    tests_root = os.path.join(repo, TESTS_DIR)
+    if not os.path.isdir(tests_root):
+        return [f"missing tests directory at {tests_root}"]
+    seen = tested_literals(tests_root)
+    problems = []
+    for cid in registered_ids():
+        if cid not in seen:
+            problems.append(
+                f"codec id {cid!r} (registered in tpudas.codec) "
+                f"never appears in {TESTS_DIR}/ — add it to the "
+                "roundtrip test matrix or unregister it"
+            )
+    return problems
+
+
+def main(argv=None) -> int:
+    repo = (argv or [None])[1] if argv and len(argv) > 1 else REPO
+    problems = lint(repo)
+    for p in problems:
+        print(p)
+    if not problems:
+        print(
+            f"check_codecs: OK ({len(registered_ids())} codec ids "
+            "covered)"
+        )
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -99,6 +99,21 @@ REQUIRED_METRICS = (
     # and as the HBM-traffic proxy
     "tpudas_fir_fused_rounds_total",
     "tpudas_fir_fused_intermediate_bytes_saved_total",
+    # compressed tile codec + scaled serving (PR 11): the PR-11 bench
+    # reads the byte counters for its savings figures, dashboards
+    # read the cache/304/pool set by name
+    "tpudas_codec_tiles_encoded_total",
+    "tpudas_codec_tiles_decoded_total",
+    "tpudas_codec_raw_bytes_total",
+    "tpudas_codec_encoded_bytes_total",
+    "tpudas_codec_encode_seconds",
+    "tpudas_codec_decode_seconds",
+    "tpudas_codec_verify_failures_total",
+    "tpudas_serve_not_modified_total",
+    "tpudas_serve_cache_evictions_total",
+    "tpudas_serve_cache_tiles",
+    "tpudas_serve_pool_workers",
+    "tpudas_serve_pool_worker_unreachable_total",
 )
 REQUIRED_SPANS = (
     "serve.request",
@@ -113,6 +128,9 @@ REQUIRED_SPANS = (
     "fleet.run",
     "fleet.step",
     "fir.fused",
+    "codec.encode",
+    "codec.decode",
+    "serve.pool_merge",
 )
 
 

@@ -633,9 +633,21 @@ def main(argv=None) -> int:
         "(each stream compared to a single-stream control replay); "
         "mutually exclusive with --mesh",
     )
+    ap.add_argument(
+        "--codec", default=None,
+        help="tile-codec spec (e.g. bitshuffle-deflate or "
+        "quantize-deflate:max_error=1e-3): sets TPUDAS_CODEC for "
+        "BOTH the drilled workers and the control replay, so the "
+        "pyramid byte-identity claim covers the compressed store "
+        "(ISSUE 11)",
+    )
     args = ap.parse_args(argv)
     if args.streams and args.mesh:
         ap.error("--streams and --mesh are mutually exclusive")
+    if args.codec:
+        # workers inherit os.environ (_run_cycle copies it), so one
+        # assignment covers every drilled cycle AND the control
+        os.environ["TPUDAS_CODEC"] = args.codec
     results = {}
     ok = True
     for engine in [e for e in args.engines.split(",") if e]:
@@ -677,7 +689,8 @@ def main(argv=None) -> int:
             f"(events={rep['detect_events']})"
         )
     payload = {"cycles": args.cycles, "seed": args.seed,
-               "mesh": args.mesh, "streams": args.streams, "ok": ok,
+               "mesh": args.mesh, "streams": args.streams,
+               "codec": args.codec, "ok": ok,
                "engines": results}
     if args.out:
         with open(args.out, "w") as fh:

@@ -16,6 +16,20 @@ Produces ``BENCH_pr04.json`` (ISSUE 4 acceptance artifact):
 Run from the repo root (CPU is fine):
 
     JAX_PLATFORMS=cpu python tools/serve_bench.py [out.json]
+
+ISSUE 11 adds the horizontal-scale + codec sweep, producing
+``BENCH_pr11.json``:
+
+    JAX_PLATFORMS=cpu python tools/serve_bench.py --pr11 [out.json]
+
+- ``codec_savings`` — bytes-on-disk across a FLEET of stores, per
+  codec (raw vs lossless bitshuffle-deflate vs controlled-lossy
+  quantize-deflate), with ratios;
+- ``scaling``       — QPS + P50/P99 latency sweep over the
+  :mod:`tpudas.serve.pool` worker pool (workers in {1, 2, 4, 8},
+  cold- and hot-cache passes, raw vs compressed store), hammered
+  from client PROCESSES so the measurement is not client-GIL-bound.
+  Acceptance: >= 4x hot QPS at 8 workers vs 1.
 """
 
 from __future__ import annotations
@@ -23,6 +37,7 @@ from __future__ import annotations
 import io
 import json
 import os
+import shutil
 import sys
 import tempfile
 import threading
@@ -295,8 +310,275 @@ def pyramid_overhead(round_measurements) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# ISSUE 11: worker-pool scaling sweep + fleet codec savings
+
+PR11_CODECS = (
+    ("raw", None),
+    ("bitshuffle-deflate", "bitshuffle-deflate"),
+    ("quantize-deflate@1e-3", "quantize-deflate:max_error=1e-3"),
+)
+PR11_WORKER_COUNTS = (1, 2, 4, 8)
+PR11_FLEET_STORES = 3
+PR11_MEASURE_S = 2.0
+PR11_CLIENT_PROCS = 8
+PR11_THREADS_PER_PROC = 4
+
+
+def _pr11_outputs(folder, seed, n_ch=256, seconds=480, fs=4.0):
+    """One synthetic processed-output stream (what the realtime
+    driver would have written) — codec input that looks like real
+    decimated DAS: band-limited signal + noise, with a gap."""
+    from tpudas.testing import synthetic_patch
+
+    os.makedirs(folder, exist_ok=True)
+    t0 = to_datetime64(T0).astype("datetime64[ns]")
+    n_files, file_s = 4, seconds // 4
+    for i in range(n_files):
+        if i == 2:
+            continue  # a missing span: NaN-gap tiles are part of the job
+        p = synthetic_patch(
+            t0=t0 + np.timedelta64(int(i * file_s), "s"),
+            duration=float(file_s), fs=fs, n_ch=n_ch, seed=seed * 17 + i,
+            noise=0.05,
+        )
+        write_patch(p, os.path.join(folder, f"LFDAS_{i:04d}.h5"))
+
+
+def _tree_bytes(path: str) -> int:
+    total = 0
+    for dirpath, _dirs, files in os.walk(path):
+        for fn in files:
+            total += os.path.getsize(os.path.join(dirpath, fn))
+    return total
+
+
+def bench_codec_savings(workdir: str) -> tuple:
+    """Build PR11_FLEET_STORES stream stores per codec from the same
+    outputs; report aggregate ``.tiles/`` bytes + ratios.  Returns
+    (report, {codec_label: [store_folder, ...]}) so the scaling sweep
+    reuses the built stores."""
+    from tpudas.serve.tiles import sync_pyramid
+
+    sources = []
+    for s in range(PR11_FLEET_STORES):
+        src = os.path.join(workdir, f"src_{s}")
+        _pr11_outputs(src, seed=s)
+        sources.append(src)
+    report = {"fleet_stores": PR11_FLEET_STORES, "per_codec": {}}
+    folders: dict = {}
+    raw_bytes = None
+    for label, spec in PR11_CODECS:
+        folders[label] = []
+        total = 0
+        t0 = time.perf_counter()
+        for s, src in enumerate(sources):
+            folder = os.path.join(workdir, f"store_{label}_{s}")
+            shutil.copytree(src, folder)
+            sync_pyramid(folder, tile_len=256, codec=spec)
+            total += _tree_bytes(os.path.join(folder, ".tiles"))
+            folders[label].append(folder)
+        entry = {
+            "tiles_bytes": total,
+            "encode_wall_s": round(time.perf_counter() - t0, 2),
+        }
+        if label == "raw":
+            raw_bytes = total
+        else:
+            entry["ratio_vs_raw"] = round(raw_bytes / total, 3)
+            entry["savings_pct"] = round(
+                (1 - total / raw_bytes) * 100, 1
+            )
+        report["per_codec"][label] = entry
+    return report, folders
+
+
+def _pr11_client(base_url, url_tails, stop_at, out_q):
+    """One hammer CLIENT PROCESS: a few threads, each holding ONE
+    persistent (keep-alive) connection and walking the window set
+    until the deadline — the CDN/edge connection shape, and the only
+    client that can actually saturate an 8-worker pool.  Reports
+    (ok, shed_503, errors, latencies)."""
+    import http.client as _hc
+    import threading as _threading
+    import time as _time
+    import urllib.parse as _up
+
+    host = _up.urlsplit(base_url).netloc
+    ok, shed, errs = [0], [0], [0]
+    lats: list = []
+    lock = _threading.Lock()
+
+    def worker(offset):
+        conn = _hc.HTTPConnection(host, timeout=30)
+        i = offset
+        while _time.time() < stop_at:
+            tail = url_tails[i % len(url_tails)]
+            i += 1
+            t0 = _time.perf_counter()
+            try:
+                conn.request("GET", tail)
+                r = conn.getresponse()
+                r.read()
+                dt = _time.perf_counter() - t0
+                with lock:
+                    if r.status == 503:
+                        shed[0] += 1
+                    elif r.status == 200:
+                        ok[0] += 1
+                        lats.append(dt)
+                    else:
+                        errs[0] += 1
+            except Exception:
+                conn.close()
+                conn = _hc.HTTPConnection(host, timeout=30)
+                with lock:
+                    errs[0] += 1
+        conn.close()
+
+    threads = [
+        _threading.Thread(target=worker, args=(j,))
+        for j in range(PR11_THREADS_PER_PROC)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    out_q.put((ok[0], shed[0], errs[0], lats))
+
+
+def _pr11_hammer(base_url, url_tails, duration_s) -> dict:
+    """Hammer from PR11_CLIENT_PROCS separate processes (the client
+    must not be the GIL bottleneck when 8 server workers scale)."""
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    out_q = ctx.Queue()
+    stop_at = time.time() + duration_s + 1.0  # workers start inside
+    procs = [
+        ctx.Process(
+            target=_pr11_client,
+            args=(base_url, url_tails, stop_at, out_q),
+        )
+        for _ in range(PR11_CLIENT_PROCS)
+    ]
+    t0 = time.perf_counter()
+    for p in procs:
+        p.start()
+    results = [out_q.get(timeout=120) for _ in procs]
+    for p in procs:
+        p.join(timeout=30)
+    elapsed = time.perf_counter() - t0
+    ok = sum(r[0] for r in results)
+    shed = sum(r[1] for r in results)
+    errs = sum(r[2] for r in results)
+    lats = np.concatenate(
+        [np.asarray(r[3]) for r in results if r[3]]
+    ) if any(r[3] for r in results) else np.asarray([0.0])
+    return {
+        "ok": int(ok),
+        "shed_503": int(shed),
+        "errors": int(errs),
+        "wall_s": round(elapsed, 2),
+        "qps": round(ok / elapsed, 1),
+        "p50_ms": round(float(np.percentile(lats, 50)) * 1e3, 2),
+        "p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 2),
+    }
+
+
+def bench_scaling(folders: dict) -> dict:
+    """QPS/P99 over the SO_REUSEPORT worker pool: workers in
+    PR11_WORKER_COUNTS x {cold, hot} cache x {raw, compressed}
+    store."""
+    from tpudas.serve.pool import ServePool
+    from tpudas.serve.tiles import TileStore
+
+    report: dict = {}
+    for label in ("raw", "bitshuffle-deflate"):
+        folder = folders[label][0]
+        store = TileStore.open(folder)
+        lo = store.t0_ns
+        hi = store.head_ns - store.step_ns
+        span = hi - lo
+        # a dashboard-shaped window set: 8 panes x 2 zooms
+        url_tails = []
+        for w in range(8):
+            a = lo + (w * span) // 10
+            b = lo + ((w + 2) * span) // 10
+            url_tails.append(
+                f"/query?t0={a}&t1={b}&max_samples=64"
+            )
+            url_tails.append(f"/query?t0={a}&t1={b}")
+        per_workers: dict = {}
+        for n in PR11_WORKER_COUNTS:
+            with ServePool(folder, port=0, workers=n) as pool:
+                # cold pass: every worker's LRU empty — the first
+                # touch of each (tile, worker) pays the disk+decode
+                cold = _pr11_hammer(
+                    pool.base_url, url_tails, PR11_MEASURE_S
+                )
+                hot = _pr11_hammer(
+                    pool.base_url, url_tails, PR11_MEASURE_S
+                )
+            per_workers[str(n)] = {"cold": cold, "hot": hot}
+            print(
+                f"  [{label}] workers={n}: hot {hot['qps']} qps "
+                f"(p99 {hot['p99_ms']} ms), cold {cold['qps']} qps",
+                flush=True,
+            )
+        base = per_workers[str(PR11_WORKER_COUNTS[0])]["hot"]["qps"]
+        peak_n = str(PR11_WORKER_COUNTS[-1])
+        peak = per_workers[peak_n]["hot"]["qps"]
+        report[label] = {
+            "workers": per_workers,
+            "speedup_8v1_hot": round(peak / base, 2) if base else None,
+            "accept_4x": bool(base and peak / base >= 4.0),
+        }
+    return report
+
+
+def main_pr11(out_path: str) -> int:
+    t_start = time.time()
+    with tempfile.TemporaryDirectory() as workdir:
+        print("building fleet stores per codec ...", flush=True)
+        savings, folders = bench_codec_savings(workdir)
+        print(json.dumps(savings, indent=1), flush=True)
+        print("scaling sweep ...", flush=True)
+        scaling = bench_scaling(folders)
+    result = {
+        "bench": "serve_pool_codec",
+        "pr": 11,
+        "config": {
+            "fleet_stores": PR11_FLEET_STORES,
+            "worker_counts": list(PR11_WORKER_COUNTS),
+            "client_procs": PR11_CLIENT_PROCS,
+            "threads_per_proc": PR11_THREADS_PER_PROC,
+            "measure_seconds": PR11_MEASURE_S,
+            "baseline": "BENCH_pr04.json qps.healthy (~120 qps, one "
+                        "ThreadingHTTPServer process)",
+        },
+        "codec_savings": savings,
+        "scaling": scaling,
+        "wall_seconds": round(time.time() - t_start, 1),
+    }
+    with open(out_path, "w") as fh:
+        json.dump(result, fh, indent=1)
+        fh.write("\n")
+    print(json.dumps(result, indent=1))
+    ok = all(v["accept_4x"] for v in scaling.values())
+    print(f"serve_bench --pr11: {'OK' if ok else 'ACCEPTANCE FAILED'} "
+          f"-> {out_path}")
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
+    if argv and argv[0] == "--pr11":
+        out = (
+            argv[1] if len(argv) > 1
+            else os.path.join(REPO, "BENCH_pr11.json")
+        )
+        return main_pr11(out)
     out_path = argv[0] if argv else os.path.join(REPO, "BENCH_pr04.json")
     reg = MetricsRegistry()
     t_start = time.time()

@@ -1,0 +1,33 @@
+"""Operator launcher for the tpudas serve worker pool (ISSUE 11).
+
+Thin CLI over :mod:`tpudas.serve.pool`: N worker processes share one
+``SO_REUSEPORT`` data port over a read-only store (single folder or
+``--fleet`` root); the parent serves the merged per-worker
+``/metrics`` and the aggregate ``/healthz`` on the control port
+(default ``port + 1``).
+
+    JAX_PLATFORMS=cpu python tools/serve_pool.py /data/out \
+        --port 8000 --workers 8
+
+See SERVING.md ("Worker pool") for the runbook and the CDN recipe
+the immutable-tile headers enable.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    from tpudas.serve.pool import main as pool_main
+
+    return pool_main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

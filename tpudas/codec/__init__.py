@@ -1,0 +1,64 @@
+"""tpudas.codec — the compressed tile codec (ISSUE 11).
+
+The serve-side tile pyramid (:mod:`tpudas.serve.tiles`) historically
+stored every completed tile as a raw ``.npy`` + ``.crc`` sidecar.
+DAS data compresses extremely well under the right transform —
+DASPack (PAPERS.md) demonstrates controlled lossless/lossy DAS
+compression at high ratios — and a fleet of stores multiplies the
+bytes.  This package is the codec layer the whole serve stack rides:
+
+- :mod:`tpudas.codec.frame` — a versioned, **self-describing** tile
+  container: one small JSON header (codec id, dtype, shape, params,
+  payload crc32, raw byte count) followed by the encoded payload.
+  The crc32 is embedded, so compressed tiles need no ``.crc``
+  sidecar and a torn write is detected from the file alone
+  (:func:`verify_tile_blob` is what the integrity audit calls).
+- :mod:`tpudas.codec.codecs` — the pluggable codec registry.  Ships
+  a lossless ``deflate``, a lossless ``bitshuffle-deflate`` (bit
+  transposition so slowly-varying float fields deflate far better),
+  and a controlled-lossy ``quantize-deflate`` whose ``max_error``
+  parameter is an absolute error *bound*, DASPack's contract —
+  quantize to an integer grid sized so the bound holds, then the
+  lossless pipeline.  All three are NaN-gap-safe: lossless codecs
+  are byte-exact by construction, the lossy codec carries NaNs
+  through a reserved integer sentinel so gap masks survive exactly.
+
+Codec selection is a **spec string** (``"bitshuffle-deflate"``,
+``"quantize-deflate:max_error=1e-3"``) accepted by the pyramid
+writer (``sync_pyramid(codec=...)`` / ``TPUDAS_CODEC=``) and by
+``rebuild_pyramid`` for offline re-encodes.  See SERVING.md
+("Compressed tile codec") for the on-disk format and the CDN story
+it unlocks.
+"""
+
+from tpudas.codec.codecs import (
+    Codec,
+    CodecError,
+    codec_ids,
+    get_codec,
+    parse_codec_spec,
+    register_codec,
+)
+from tpudas.codec.frame import (
+    MAGIC,
+    TILE_BLOB_SUFFIX,
+    decode_tile,
+    encode_tile,
+    read_tile_header,
+    verify_tile_blob,
+)
+
+__all__ = [
+    "Codec",
+    "CodecError",
+    "MAGIC",
+    "TILE_BLOB_SUFFIX",
+    "codec_ids",
+    "decode_tile",
+    "encode_tile",
+    "get_codec",
+    "parse_codec_spec",
+    "read_tile_header",
+    "register_codec",
+    "verify_tile_blob",
+]

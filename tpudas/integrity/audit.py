@@ -15,6 +15,8 @@ artifact            files
 ``index``           ``.tpudas_index.json`` (+ ``.prev``)
 ``pyramid``         ``.tiles/manifest.json`` (+ ``.prev``),
                     ``.tiles/tails.npy``, ``.tiles/L*/NNNNNNNN.npy``
+                    and compressed ``.tiles/L*/NNNNNNNN.tpt`` blobs
+                    (verified via their embedded crc32 — ISSUE 11)
 ``detect_carry``    ``.detect/carry.npz`` (+ ``.crc``/``.prev``)
 ``events``          ``.detect/events.jsonl`` (+ ``.prev``) — per-line
                     crc32 stamps, contiguous ``seq``
@@ -88,6 +90,9 @@ from tpudas.utils.logging import log_event
 __all__ = ["audit", "audit_fleet", "fleet_stream_dirs"]
 
 _TILE_NAME_RE = re.compile(r"^(\d{8})\.npy$")
+# compressed pyramid tiles (tpudas.codec blobs, ISSUE 11): the crc is
+# embedded in the container, so verification reads the file alone
+_TILE_BLOB_NAME_RE = re.compile(r"^(\d{8})\.tpt$")
 
 
 def _issue(issues, artifact, path, status, action, detail=""):
@@ -402,20 +407,50 @@ def _check_outputs(folder: str, issues: list, repair: bool) -> None:
         )
 
 
+def _tile_blob_status(path: str) -> str:
+    """``ok`` | ``torn`` | ``corrupt`` | ``absent`` for one
+    compressed tile blob, via its embedded crc plus a full decode (a
+    blob whose payload verifies but whose codec params cannot
+    reproduce the declared geometry is corrupt, not ok)."""
+    from tpudas.codec import decode_tile, verify_tile_blob
+
+    try:
+        with open(path, "rb") as fh:
+            blob = fh.read()
+    except FileNotFoundError:
+        return "absent"
+    except OSError:
+        return "corrupt"
+    status = verify_tile_blob(blob)
+    if status != "ok":
+        return status
+    try:
+        decode_tile(blob)
+    except Exception:
+        return "corrupt"
+    return "ok"
+
+
 def _raw_manifest_geometry(manifest: str) -> tuple:
-    """(factor, tile_len) from whichever manifest rung still parses —
-    a checksum-IGNORED read, used only to preserve the pyramid
-    geometry across a rebuild.  (None, None) when nothing parses."""
+    """(factor, tile_len, codec) from whichever manifest rung still
+    parses — a checksum-IGNORED read, used only to preserve the
+    pyramid geometry (and tile codec, ISSUE 11) across a rebuild.
+    (None, None, None) when nothing parses; ``codec`` is the
+    ``(id_or_None, params)`` pair :func:`rebuild_pyramid` accepts."""
     import json
 
     for path in (manifest, manifest + ".prev"):
         try:
             with open(path) as fh:
                 raw = json.load(fh)
-            return int(raw["factor"]), int(raw["tile_len"])
+            codec = (
+                raw.get("codec") or None,
+                dict(raw.get("codec_params") or {}),
+            )
+            return int(raw["factor"]), int(raw["tile_len"]), codec
         except (OSError, ValueError, KeyError, TypeError):
             continue
-    return None, None
+    return None, None, None
 
 
 def _tile_in_use(store, level: int, tile_idx: int) -> bool:
@@ -450,7 +485,9 @@ def _check_pyramid(
     had_manifest = os.path.isfile(manifest) or os.path.isfile(
         manifest + ".prev"
     )
-    geom_factor, geom_tile_len = _raw_manifest_geometry(manifest)
+    geom_factor, geom_tile_len, geom_codec = _raw_manifest_geometry(
+        manifest
+    )
     _check_json_artifact(manifest, "manifest", issues, repair)
     store = TileStore.open(folder)
     need_rebuild = False
@@ -504,33 +541,42 @@ def _check_pyramid(
             continue
         for name in sorted(os.listdir(level_dir)):
             m = _TILE_NAME_RE.match(name)
-            if m is None:
+            mb = _TILE_BLOB_NAME_RE.match(name)
+            if m is None and mb is None:
                 continue
-            tile_idx = int(m.group(1))
+            tile_idx = int((m or mb).group(1))
             path = os.path.join(level_dir, name)
-            try:
-                crc = verify_file_checksum(path, artifact="tile")
-            except FileNotFoundError:
-                continue
-            ok_parse = True
-            if crc != "mismatch":
+            if mb is not None:
+                # compressed tile: the container's embedded crc32 is
+                # the stamp — never "unstamped", a blob either
+                # verifies or takes the ladder
+                status = _tile_blob_status(path)
+                if status in ("ok", "absent"):
+                    continue
+            else:
                 try:
-                    import numpy as np
+                    crc = verify_file_checksum(path, artifact="tile")
+                except FileNotFoundError:
+                    continue
+                ok_parse = True
+                if crc != "mismatch":
+                    try:
+                        import numpy as np
 
-                    np.load(path)
-                except Exception:
-                    ok_parse = False
-            if crc == "ok" and ok_parse:
-                continue
-            if crc == "unstamped" and ok_parse:
-                if repair:
-                    write_sidecar_for(path)
-                _issue(
-                    issues, "tile", path, "unstamped",
-                    _repair_action(repair, "restamped"),
-                )
-                continue
-            status = "torn" if crc == "mismatch" else "corrupt"
+                        np.load(path)
+                    except Exception:
+                        ok_parse = False
+                if crc == "ok" and ok_parse:
+                    continue
+                if crc == "unstamped" and ok_parse:
+                    if repair:
+                        write_sidecar_for(path)
+                    _issue(
+                        issues, "tile", path, "unstamped",
+                        _repair_action(repair, "restamped"),
+                    )
+                    continue
+                status = "torn" if crc == "mismatch" else "corrupt"
             if _tile_in_use(store, level, tile_idx):
                 need_rebuild = True
                 _issue(issues, "tile", path, status, "pending_rebuild")
@@ -545,7 +591,8 @@ def _check_pyramid(
         if repair and rebuild:
             try:
                 rows = rebuild_pyramid(
-                    folder, factor=geom_factor, tile_len=geom_tile_len
+                    folder, factor=geom_factor,
+                    tile_len=geom_tile_len, codec=geom_codec,
                 )
             except Exception as exc:
                 log_event(

@@ -12,26 +12,45 @@ interactive latency without re-reading raw files:
   by an LRU tile cache with single-flight request coalescing and a
   full-resolution file fallback for windows older than the pyramid;
 - :mod:`tpudas.serve.http` — a zero-dependency threaded HTTP server
-  (``/query``, ``/waterfall``, ``/events``, ``/healthz``,
+  (``/query``, ``/waterfall``, ``/tile``, ``/events``, ``/healthz``,
   ``/metrics``) with a bounded admission gate that sheds load with
-  503 + Retry-After.  ``/events`` is the detection query plane over
-  the :mod:`tpudas.detect` events ledger and score tiles.
+  503 + Retry-After, strong ETags/conditional GET, and
+  immutable-tile ``Cache-Control`` for CDN absorption (ISSUE 11).
+  ``/events`` is the detection query plane over the
+  :mod:`tpudas.detect` events ledger and score tiles.
+- :mod:`tpudas.serve.pool` — the horizontal-scale tier: N server
+  processes over one read-only store sharing a single
+  ``SO_REUSEPORT`` data port, merged ``/metrics`` + aggregate
+  ``/healthz`` control plane (``tools/serve_pool.py``).
 
-See SERVING.md for the pyramid format, endpoint reference and the
-operator runbook.
+Completed tiles are stored raw or through the pluggable
+:mod:`tpudas.codec` compressed tile container
+(``codec=``/``TPUDAS_CODEC=``).  See SERVING.md for the pyramid and
+blob formats, endpoint reference, CDN recipe and the operator
+runbook.
 """
 
 from tpudas.serve.query import QueryEngine, QueryResult
-from tpudas.serve.tiles import TileStore, sync_pyramid
+from tpudas.serve.tiles import TileStore, rebuild_pyramid, sync_pyramid
 
 __all__ = [
     "QueryEngine",
     "QueryResult",
+    "ServePool",
     "TileStore",
+    "rebuild_pyramid",
     "sync_pyramid",
     "serve_forever",
     "start_server",
 ]
+
+
+def ServePool(*args, **kwargs):  # noqa: N802 - class-shaped factory
+    """Lazy re-export of :class:`tpudas.serve.pool.ServePool` (keeps
+    ``import tpudas.serve`` free of multiprocessing/http.server)."""
+    from tpudas.serve.pool import ServePool as _Pool
+
+    return _Pool(*args, **kwargs)
 
 
 def start_server(*args, **kwargs):

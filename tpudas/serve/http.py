@@ -28,6 +28,19 @@ Endpoints (all GET):
   ``max_px`` (time-axis pixel budget, default 1024); picks the pyramid
   level from the budget and adds symmetric 95th-percentile color
   limits in ``X-Tpudas-Clim-*`` headers.
+- ``/tile``      — one pyramid tile by address (``level``, ``idx``):
+  the CDN-shaped read path (ISSUE 11).  Completed tiles are immutable
+  and ship with a strong ETag + ``Cache-Control: public,
+  max-age=31536000, immutable``; the partial head tile is
+  ``no-cache``.  On a compressed store, ``Accept-Encoding: x-tpt``
+  gets the stored :mod:`tpudas.codec` blob verbatim.
+
+Every data-plane response carries a strong content-derived ``ETag``
+and honors ``If-None-Match`` (``304`` with no body on a match), and
+``/query``/``/waterfall`` bodies are ``deflate``-encoded when the
+client asks (``Accept-Encoding: deflate``) — so a CDN/edge cache
+absorbs the immutable traffic and revalidates the rest for header
+cost.  See SERVING.md "CDN deployment".
 - ``/events``    — the detection query plane (tpudas.detect): events
   from the integrity-verified ledger filtered by time window
   (``t0``/``t1``, optional), channel range (``c0``/``c1``),
@@ -62,14 +75,18 @@ from __future__ import annotations
 import io
 import json
 import os
+import socket
 import threading
 import time
 import urllib.parse
+import zlib
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from tpudas.codec import TILE_BLOB_SUFFIX, decode_tile, read_tile_header
 from tpudas.core.timeutils import to_datetime64
+from tpudas.integrity.checksum import crc32_hex
 from tpudas.obs.health import read_health
 from tpudas.obs.registry import get_registry
 from tpudas.obs.trace import span
@@ -80,9 +97,19 @@ from tpudas.utils.logging import log_event
 __all__ = ["DASServer", "start_server", "serve_forever"]
 
 _DEFAULT_MAX_INFLIGHT = 8
-_DATA_ENDPOINTS = ("/query", "/waterfall", "/events")
+_DATA_ENDPOINTS = ("/query", "/waterfall", "/events", "/tile")
 _DEFAULT_EVENTS_LIMIT = 1000
 _DEFAULT_SCORES_LIMIT = 10000
+# completed full tiles (and windows served entirely from them) can
+# never change short of a pyramid rebuild: let a CDN keep them forever
+_IMMUTABLE_CC = "public, max-age=31536000, immutable"
+# everything touching mutable state (tails, head tile, file fallback)
+# must revalidate at origin every time — the ETag makes that a 304
+_MUTABLE_CC = "no-cache"
+# the custom Accept-Encoding token under which /tile ships the stored
+# compressed blob verbatim (self-describing tpudas.codec container)
+_TPT_CODING = "x-tpt"
+_MIN_DEFLATE_BYTES = 256
 
 
 class _Mount:
@@ -235,6 +262,59 @@ class _Handler(BaseHTTPRequestHandler):
         body = (json.dumps(payload, indent=1) + "\n").encode()
         self._send(status, body, "application/json", headers)
 
+    # -- HTTP caching helpers (ISSUE 11) -------------------------------
+    def _accepts(self, coding: str) -> bool:
+        """Whether the request accepts one content-coding token —
+        q-values honored, so ``deflate;q=0`` is an explicit refusal,
+        not a match."""
+        for item in self.headers.get("Accept-Encoding", "").split(","):
+            token, _, params = item.partition(";")
+            if token.strip().lower() != coding:
+                continue
+            q = 1.0
+            for p in params.split(";"):
+                k, _, v = p.partition("=")
+                if k.strip().lower() == "q":
+                    try:
+                        q = float(v.strip())
+                    except ValueError:
+                        q = 0.0
+            return q > 0.0
+        return False
+
+    def _maybe_deflate(self, body: bytes) -> tuple:
+        """(body, extra_headers): deflate-encode a data-plane body the
+        client asked for (``Accept-Encoding: deflate``) when it is
+        big enough to be worth it.  ``Vary`` is always set — the
+        representation depends on the request's encoding, cached
+        intermediaries must key on it."""
+        headers = [("Vary", "Accept-Encoding")]
+        if self._accepts("deflate") and len(body) > _MIN_DEFLATE_BYTES:
+            body = zlib.compress(body, 6)
+            headers.append(("Content-Encoding", "deflate"))
+        return body, headers
+
+    def _send_cacheable(self, body: bytes, content_type: str,
+                        headers, cache_control: str) -> int:
+        """Send one data-plane representation with a strong
+        content-derived ETag and the given ``Cache-Control``; answer
+        the request's ``If-None-Match`` with an empty ``304`` when
+        the representation is unchanged (a CDN revalidation costs
+        headers, not payload bytes)."""
+        etag = f'"{crc32_hex(body)}-{len(body)}"'
+        headers = list(headers) + [
+            ("ETag", etag), ("Cache-Control", cache_control),
+        ]
+        if self.headers.get("If-None-Match") == etag:
+            get_registry().counter(
+                "tpudas_serve_not_modified_total",
+                "conditional GETs answered 304 from a matching ETag",
+            ).inc()
+            self._send(304, b"", content_type, headers)
+            return 304
+        self._send(200, body, content_type, headers)
+        return 200
+
     # -- routing -------------------------------------------------------
     def _resolve_mount(self, path):
         """(mount_or_None, endpoint, stream_id_or_None): strips the
@@ -348,6 +428,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._query(mount, params, waterfall=True)
         if endpoint == "/events":
             return self._events(mount, params)
+        if endpoint == "/tile":
+            return self._tile(mount, params)
         self._send_json(404, {"error": f"unknown endpoint {endpoint!r}"})
         return 404
 
@@ -533,11 +615,114 @@ class _Handler(BaseHTTPRequestHandler):
             "tpudas_serve_events_queries_total",
             "/events queries answered from the verified ledger",
         ).inc()
-        self._send_json(
-            200, payload,
-            headers=(("X-Tpudas-Events-Total", total),),
+        # events are live mutable state: origin-only, but still ETag-
+        # revalidatable (a polling dashboard's unchanged ledger costs
+        # headers, not the serialized event list)
+        body = (json.dumps(payload, indent=1) + "\n").encode()
+        return self._send_cacheable(
+            body, "application/json",
+            [("X-Tpudas-Events-Total", total)], _MUTABLE_CC,
         )
-        return 200
+
+    def _tile(self, mount, params: dict) -> int:
+        """One pyramid tile by address (``level``, ``idx``) — the
+        CDN-shaped read path (ISSUE 11).  A COMPLETED tile is
+        immutable by construction, so it ships with a strong ETag and
+        ``Cache-Control: immutable``: an edge cache absorbs every
+        repeat read forever.  The trailing PARTIAL tile is the
+        mutable hot path and stays ``no-cache`` (revalidated at
+        origin per request).  Under a compressed store a client that
+        advertises ``Accept-Encoding: x-tpt`` gets the stored
+        :mod:`tpudas.codec` blob verbatim (zero-copy off disk,
+        self-describing — decode client-side); everyone else gets
+        decoded raw ``.npy`` bytes."""
+        from tpudas.serve.tiles import AGGS
+
+        if "level" not in params or "idx" not in params:
+            raise ValueError(
+                "level and idx query parameters are required"
+            )
+        level = int(params["level"])
+        idx = int(params["idx"])
+        if level < 0 or idx < 0:
+            raise ValueError("level and idx must be non-negative")
+        store = mount.engine._refresh_store()
+        if store is None or store.head_ns is None:
+            self._send_json(
+                404, {"error": "no tile pyramid in this folder"}
+            )
+            return 404
+        n_level = store.n(level) if level < store.n_levels else 0
+        valid = min(store.tile_len, n_level - idx * store.tile_len)
+        if valid <= 0:
+            self._send_json(
+                404,
+                {"error": f"tile L{level}/{idx} is beyond the "
+                          f"pyramid head",
+                 "levels": list(store.levels),
+                 "tile_len": int(store.tile_len)},
+            )
+            return 404
+        headers = [
+            ("X-Tpudas-Level", level),
+            ("X-Tpudas-Tile", idx),
+            ("X-Tpudas-Valid-Rows", valid),
+            ("X-Tpudas-Codec", store.codec or "raw"),
+            ("Vary", "Accept-Encoding"),
+        ]
+        if valid == store.tile_len:
+            path = store.resolve_tile_path(level, idx)
+            if path is None:
+                raise FileNotFoundError(
+                    f"manifest references tile L{level}/{idx} but no "
+                    "tile file exists (corrupt store)"
+                )
+            with open(path, "rb") as fh:
+                blob = fh.read()
+            # verify BEFORE the immutable header: a torn/bit-rotted
+            # tile served with max-age=31536000 poisons a CDN for a
+            # year — every other read path takes the corrupt-store
+            # ladder, this one must too
+            if path.endswith(TILE_BLOB_SUFFIX):
+                from tpudas.codec import verify_tile_blob
+                from tpudas.serve.tiles import CorruptStoreError
+
+                if verify_tile_blob(blob) != "ok":
+                    raise CorruptStoreError(
+                        f"tile L{level}/{idx} failed its embedded "
+                        "crc32 check — run tools/fsck.py to rebuild"
+                    )
+                if self._accepts(_TPT_CODING):
+                    # stored compressed blob, verbatim: the cheapest
+                    # possible origin read, and what a CDN should cache
+                    return self._send_cacheable(
+                        blob, "application/x-tpudas-tile",
+                        headers + [("Content-Encoding", _TPT_CODING)],
+                        _IMMUTABLE_CC,
+                    )
+                arr = decode_tile(blob)
+                buf = io.BytesIO()
+                np.save(buf, np.ascontiguousarray(arr))
+                body = buf.getvalue()
+            else:
+                # raw .npy bytes ARE the representation — after the
+                # sidecar-crc gate (raises CorruptStoreError -> 500)
+                store._verify_tile(path)
+                body = blob
+            return self._send_cacheable(
+                body, "application/x-npy", headers, _IMMUTABLE_CC
+            )
+        # the growing head tile: serve its current rows, never cache
+        tile = store._load_tile(level, idx)
+        arr = (
+            tile["mean"] if level == 0
+            else np.stack([tile[agg] for agg in AGGS], axis=0)
+        )
+        buf = io.BytesIO()
+        np.save(buf, np.ascontiguousarray(arr))
+        return self._send_cacheable(
+            buf.getvalue(), "application/x-npy", headers, _MUTABLE_CC
+        )
 
     def _query(self, mount, params: dict, waterfall: bool) -> int:
         if "t0" not in params or "t1" not in params:
@@ -589,9 +774,11 @@ class _Handler(BaseHTTPRequestHandler):
                 ("X-Tpudas-Clim-Lo", repr(float(lo))),
                 ("X-Tpudas-Clim-Hi", repr(float(hi))),
             ]
+        cache_control = (
+            _IMMUTABLE_CC if result.immutable else _MUTABLE_CC
+        )
         if params.get("format", "npy") == "json":
-            self._send_json(
-                200,
+            body = (json.dumps(
                 {
                     "times_ns": [
                         int(t) for t in
@@ -605,24 +792,53 @@ class _Handler(BaseHTTPRequestHandler):
                     "agg": result.agg,
                     "source": result.source,
                 },
-                headers=headers,
-            )
-            return 200
-        buf = io.BytesIO()
-        np.save(buf, np.ascontiguousarray(result.data))
-        self._send(200, buf.getvalue(), "application/x-npy", headers)
-        return 200
+                indent=1,
+            ) + "\n").encode()
+            content_type = "application/json"
+        else:
+            buf = io.BytesIO()
+            np.save(buf, np.ascontiguousarray(result.data))
+            body = buf.getvalue()
+            content_type = "application/x-npy"
+        body, enc_headers = self._maybe_deflate(body)
+        return self._send_cacheable(
+            body, content_type, headers + enc_headers, cache_control
+        )
 
 
 class _Server(ThreadingHTTPServer):
     daemon_threads = True
     allow_reuse_address = True
+    # the stdlib default listen backlog (5) makes a thundering herd
+    # pay 1-second SYN retransmits long before the admission gate
+    # even sees the request; shedding is the GATE's job, done with an
+    # explicit 503, not silent kernel queue drops
+    request_queue_size = 128
 
-    def __init__(self, addr, mount, mounts, gate):
+    def __init__(self, addr, mount, mounts, gate, reuse_port=False):
         self.mount = mount  # root _Mount or None (fleet-only server)
         self.mounts = dict(mounts)  # stream_id -> _Mount
         self.gate = gate
+        # SO_REUSEPORT lets N worker PROCESSES bind the same port and
+        # have the kernel load-balance accepted connections across
+        # them — the tpudas.serve.pool horizontal-scale mechanism
+        # (the crash-only tile format already makes concurrent
+        # readers safe, so workers share the store read-only)
+        self._reuse_port = bool(reuse_port)
         super().__init__(addr, _Handler)
+
+    def server_bind(self):
+        if self._reuse_port:
+            if not hasattr(socket, "SO_REUSEPORT"):
+                raise OSError(
+                    "SO_REUSEPORT is not available on this platform; "
+                    "run single-process or front workers with a "
+                    "balancer"
+                )
+            self.socket.setsockopt(
+                socket.SOL_SOCKET, socket.SO_REUSEPORT, 1
+            )
+        super().server_bind()
 
     @property
     def folder(self):  # legacy accessor (pre-fleet single-folder API)
@@ -646,7 +862,7 @@ class DASServer:
 
     def __init__(self, folder=None, host="127.0.0.1", port=0,
                  max_inflight=_DEFAULT_MAX_INFLIGHT, cache_tiles=256,
-                 engine=None, streams=None):
+                 engine=None, streams=None, reuse_port=False):
         if folder is None and not streams:
             raise ValueError(
                 "DASServer needs a folder, streams, or both"
@@ -669,7 +885,7 @@ class DASServer:
         self.mounts = mounts
         self._httpd = _Server(
             (host, int(port)), mount, mounts,
-            _AdmissionGate(max_inflight),
+            _AdmissionGate(max_inflight), reuse_port=reuse_port,
         )
         self._thread = None
 

@@ -61,6 +61,11 @@ class QueryResult:
     step_ns: int
     agg: str
     source: str
+    # True when the window was served ENTIRELY from completed
+    # (immutable) full tiles: the response bytes can never change
+    # short of a pyramid rebuild, so the HTTP layer may mark it
+    # CDN-cacheable forever (SERVING.md "CDN deployment")
+    immutable: bool = False
 
     @property
     def n_samples(self) -> int:
@@ -121,10 +126,18 @@ class QueryEngine:
 
     # -- the tile cache ------------------------------------------------
     def _tile_key(self, store, level, tile_idx):
+        # keyed on (tile, valid rows, store generation, codec): valid
+        # refreshes the growing head tile per append; generation+codec
+        # key out a rebuild_pyramid re-encode — same tile index,
+        # different bytes — so a re-encoded store can never serve a
+        # stale pre-rebuild decoded array (ISSUE 11 cache fix)
         valid = min(
             store.tile_len, store.n(level) - tile_idx * store.tile_len
         )
-        return (int(level), int(tile_idx), int(valid))
+        return (
+            int(level), int(tile_idx), int(valid),
+            int(store.generation), store.codec or "raw",
+        )
 
     def cache_info(self) -> dict:
         with self._lock:
@@ -481,6 +494,11 @@ class QueryEngine:
             np.asarray(store.t0_ns + np.arange(i_lo, i_hi_eff) * stepk)
             .astype("datetime64[ns]")
         )
+        # immutable = every row came from a COMPLETED full tile (no
+        # file fallback, no growing head tile): those bytes are
+        # append-proof, so the HTTP layer can mark the response
+        # CDN-cacheable forever
+        n_full_rows = (n_k // store.tile_len) * store.tile_len
         return QueryResult(
             times=times,
             distance=np.asarray(store.distance, dtype=np.float64),
@@ -490,6 +508,9 @@ class QueryEngine:
             agg=agg,
             source=(
                 "mixed" if len(set(source)) > 1 else source[0]
+            ),
+            immutable=bool(
+                set(source) == {"tiles"} and i_hi_eff <= n_full_rows
             ),
         )
 

@@ -31,6 +31,18 @@ Layout (all under ``<folder>/.tiles/``):
   data; coarser tiles stack the three aggregates as ``(3, rows,
   n_ch)`` in :data:`AGGS` order.  A tile file is written exactly once,
   when it completes — full tiles are immutable.
+- ``L<level>/<tile_index>.tpt`` — the same complete tiles under a
+  compressed store (``codec=`` / ``TPUDAS_CODEC=``, ISSUE 11): one
+  self-describing :mod:`tpudas.codec` blob per tile, crc embedded (no
+  ``.crc`` sidecar).  Only COMPLETE tiles are encoded — ``tails.npy``
+  and the manifest stay raw, they are the mutable per-round hot path.
+  Reads accept both suffixes (codec-preferred), so a legacy raw store
+  keeps serving untouched and a half-converted (mixed) store is
+  consistent file by file.  Under a LOSSY codec incoming rows are
+  first *conditioned* onto the codec's representable grid
+  (:attr:`tpudas.codec.Codec.condition`), so every value on disk —
+  tails included — obeys the codec's error bound and the incremental
+  build stays byte-identical to an offline rebuild.
 - ``tails.npy`` — every level's trailing PARTIAL tile in one
   self-describing file (header: ``[n_entries, (level, planes, rows,
   base_hi, base_lo) ...]``, then the row data), rewritten atomically
@@ -67,6 +79,14 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from tpudas.codec import (
+    CodecError,
+    TILE_BLOB_SUFFIX,
+    decode_tile,
+    encode_tile,
+    get_codec,
+    parse_codec_spec,
+)
 from tpudas.core.timeutils import to_datetime64
 from tpudas.integrity.checksum import (
     count_fallback,
@@ -79,6 +99,7 @@ from tpudas.integrity.checksum import (
 )
 from tpudas.obs.registry import get_registry
 from tpudas.resilience.faults import fault_point
+from tpudas.utils.atomicio import atomic_write_bytes
 from tpudas.utils.logging import log_event
 
 __all__ = [
@@ -114,37 +135,73 @@ _DEFAULT_TILE_LEN = 256
 _STORE_DTYPE = np.float32
 
 
-def rebuild_pyramid(folder, engine=None, factor=None, tile_len=None) -> int:
+def _resolve_codec(codec) -> tuple:
+    """``(codec_id, params)`` from a spec string, an already-split
+    ``(id, params)`` pair, or None — every codec entry point funnels
+    through here so an unknown id fails loudly at config time."""
+    if isinstance(codec, tuple):
+        cid, params = codec
+        if cid is not None:
+            get_codec(cid)  # unknown id -> CodecError now, not at read
+        return cid, dict(params or {})
+    return parse_codec_spec(codec)
+
+
+def rebuild_pyramid(
+    folder, engine=None, factor=None, tile_len=None, codec=None
+) -> int:
     """The degradation ladder's last pyramid rung: delete ``.tiles/``
     and rebuild it from the output files via :func:`sync_pyramid` —
     byte-identical to the incremental build, because the reduction is
-    deterministic.  The original ``factor``/``tile_len`` are recovered
-    from whatever manifest rung still parses (the geometry must
-    survive the rebuild, or the "byte-identical" claim breaks); env
-    defaults apply only when nothing is recoverable.  Returns the
-    number of level-0 rows in the rebuilt pyramid."""
+    deterministic.  The original ``factor``/``tile_len``/codec are
+    recovered from whatever manifest rung still parses (the geometry
+    must survive the rebuild, or the "byte-identical" claim breaks);
+    env defaults apply only when nothing is recoverable.
+
+    ``codec`` is also the offline **re-encode** entry point (ISSUE
+    11): pass a codec spec (``"bitshuffle-deflate"``,
+    ``"quantize-deflate:max_error=1e-3"``, or ``"raw"`` to strip
+    compression) to rebuild the whole pyramid in that format; the
+    default (None) preserves the store's recorded codec.  The
+    manifest ``generation`` is bumped either way, so query-layer
+    decoded-tile caches can never serve a pre-rebuild array.
+
+    Returns the number of level-0 rows in the rebuilt pyramid."""
     import json as _json
     import shutil
 
     tiles_dir = os.path.join(str(folder), TILE_DIRNAME)
-    if factor is None or tile_len is None:
-        store = TileStore.open(folder)
-        if store is not None:
-            factor = factor or store.factor
-            tile_len = tile_len or store.tile_len
-        else:
-            # last resort: a raw (checksum-ignored) parse of either
-            # manifest rung just for the two geometry fields
-            base = os.path.join(tiles_dir, MANIFEST_FILENAME)
-            for path in (base, base + ".prev"):
-                try:
-                    with open(path) as fh:
-                        raw = _json.load(fh)
-                    factor = factor or int(raw["factor"])
-                    tile_len = tile_len or int(raw["tile_len"])
-                    break
-                except (OSError, ValueError, KeyError, TypeError):
-                    continue
+    # recovery always runs (not just for missing args): the
+    # generation counter must survive the rebuild, or a held query
+    # engine could key rebuilt tiles back into pre-rebuild cache slots
+    generation = 0
+    recovered_codec: tuple | None = None
+    store = TileStore.open(folder)
+    if store is not None:
+        factor = factor or store.factor
+        tile_len = tile_len or store.tile_len
+        generation = store.generation
+        recovered_codec = (store.codec, store.codec_params)
+    else:
+        # last resort: a raw (checksum-ignored) parse of either
+        # manifest rung just for the geometry + codec fields
+        base = os.path.join(tiles_dir, MANIFEST_FILENAME)
+        for path in (base, base + ".prev"):
+            try:
+                with open(path) as fh:
+                    raw = _json.load(fh)
+                factor = factor or int(raw["factor"])
+                tile_len = tile_len or int(raw["tile_len"])
+                generation = int(raw.get("generation", 0))
+                recovered_codec = (
+                    raw.get("codec") or None,
+                    dict(raw.get("codec_params") or {}),
+                )
+                break
+            except (OSError, ValueError, KeyError, TypeError):
+                continue
+    if codec is None:
+        codec = recovered_codec  # preserve the recorded format
     if os.path.isdir(tiles_dir):
         shutil.rmtree(tiles_dir, ignore_errors=True)
     get_registry().counter(
@@ -153,8 +210,17 @@ def rebuild_pyramid(folder, engine=None, factor=None, tile_len=None) -> int:
         "(corrupt-store recovery)",
     ).inc()
     log_event("pyramid_rebuilt", folder=str(folder))
+    # the rebuilt store is a NEW tile generation: even a content-
+    # identical lossless rebuild bumps it (cheap — one cold refill of
+    # the decoded-tile LRU), because a lossy or cross-codec rebuild
+    # MUST invalidate every cached decoded array.  The bumped counter
+    # goes into the FRESH manifest from its very first save — a
+    # post-sync fixup would leave a window (or, after a crash mid-
+    # rebuild, a permanent state) where re-encoded tiles still read
+    # as the old generation and key into stale cache slots
     return sync_pyramid(
-        folder, factor=factor, tile_len=tile_len, engine=engine
+        folder, factor=factor, tile_len=tile_len, engine=engine,
+        codec=codec, generation=int(generation) + 1,
     )
 
 
@@ -214,6 +280,15 @@ class TileStore:
     factor: int = _DEFAULT_FACTOR
     tile_len: int = _DEFAULT_TILE_LEN
     engine: str | None = None  # reduction engine ("numpy" = host, default)
+    # tile codec id (tpudas.codec registry; None = legacy raw .npy)
+    # + its persisted parameters — both recorded in the manifest, so
+    # the store that wrote a tile always knows how to read it back
+    codec: str | None = None
+    codec_params: dict = field(default_factory=dict)
+    # bumped by rebuild_pyramid: lets the query engine's decoded-tile
+    # LRU key out stale entries after a re-encode (same tile index,
+    # different bytes)
+    generation: int = 0
     t0_ns: int | None = None  # grid anchor (first level-0 sample time)
     step_ns: int | None = None  # level-0 grid step
     n_ch: int | None = None
@@ -255,6 +330,25 @@ class TileStore:
             self.tiles_dir, f"L{int(level)}", f"{int(tile_idx):08d}.npy"
         )
 
+    def tile_blob_path(self, level: int, tile_idx: int) -> str:
+        return os.path.join(
+            self.tiles_dir,
+            f"L{int(level)}",
+            f"{int(tile_idx):08d}{TILE_BLOB_SUFFIX}",
+        )
+
+    def resolve_tile_path(self, level: int, tile_idx: int) -> str | None:
+        """The on-disk file for one tile, whichever format it is in —
+        the store's codec format preferred, the other accepted (a
+        mixed raw+compressed store reads consistently file by file).
+        None when neither exists."""
+        blob = self.tile_blob_path(level, tile_idx)
+        raw = self.tile_path(level, tile_idx)
+        for path in (blob, raw) if self.codec else (raw, blob):
+            if os.path.isfile(path):
+                return path
+        return None
+
     # -- lifecycle -----------------------------------------------------
     @classmethod
     def create(
@@ -263,20 +357,27 @@ class TileStore:
         factor: int = _DEFAULT_FACTOR,
         tile_len: int = _DEFAULT_TILE_LEN,
         engine=None,
+        codec=None,
     ) -> "TileStore":
         """A fresh, empty pyramid for ``folder`` (no manifest written
-        until the first :meth:`append`)."""
+        until the first :meth:`append`).  ``codec`` is a
+        :func:`tpudas.codec.parse_codec_spec` spec string (or
+        ``(id, params)`` pair) selecting the compressed tile format;
+        None/"raw" keeps legacy raw ``.npy`` tiles."""
         if int(factor) < 2:
             raise ValueError(f"pyramid factor must be >= 2, got {factor}")
         if int(tile_len) < int(factor):
             raise ValueError(
                 f"tile_len {tile_len} must be >= factor {factor}"
             )
+        codec_id, codec_params = _resolve_codec(codec)
         return cls(
             folder=str(folder),
             factor=int(factor),
             tile_len=int(tile_len),
             engine=engine,
+            codec=codec_id,
+            codec_params=codec_params,
         )
 
     @classmethod
@@ -328,6 +429,14 @@ class TileStore:
                 self.n_ch = int(raw["n_ch"])
                 self.distance = np.asarray(raw["distance"], dtype=np.float64)
                 self.levels = [int(n) for n in raw["levels"]]
+                # codec keys are absent on pre-ISSUE-11 manifests:
+                # their absence IS the raw-store signal
+                codec = raw.get("codec") or None
+                if codec is not None:
+                    get_codec(codec)  # unknown id = unreadable store
+                self.codec = codec
+                self.codec_params = dict(raw.get("codec_params") or {})
+                self.generation = int(raw.get("generation", 0))
                 # stat-gate future refreshes only off the PRIMARY (a
                 # .prev fallback must re-check the primary next time)
                 self._manifest_stat = stat_key if path == base else None
@@ -336,7 +445,8 @@ class TileStore:
                 return True
             except FileNotFoundError:
                 continue
-            except (OSError, ValueError, KeyError, TypeError) as exc:
+            except (OSError, ValueError, KeyError, TypeError,
+                    CodecError) as exc:
                 get_registry().counter(
                     "tpudas_serve_manifest_unreadable_total",
                     "pyramid manifests that failed to parse (fell back "
@@ -358,6 +468,9 @@ class TileStore:
         self.n_ch = None
         self.distance = None
         self.levels = []
+        self.codec = None
+        self.codec_params = {}
+        self.generation = 0
         self._manifest_stat = None
         self._tails_state = None
         return False
@@ -388,6 +501,13 @@ class TileStore:
             "distance": [float(d) for d in self.distance],
             "levels": [int(n) for n in self.levels],
         }
+        if self.codec is not None:
+            # keys only present on compressed stores, so a raw store's
+            # manifest is byte-identical to what pre-codec code wrote
+            payload["codec"] = self.codec
+            payload["codec_params"] = dict(self.codec_params)
+        if self.generation:
+            payload["generation"] = int(self.generation)
         path = self.manifest_path
         # rename-not-copy double buffer, same as health.json: the
         # outgoing good manifest survives as .prev for torn-read
@@ -563,10 +683,9 @@ class TileStore:
         arr = self._tail_for(level, tile_idx, off)
         if arr is not None:
             return arr[keep]
-        path = self.tile_path(level, tile_idx)
-        if os.path.isfile(path):
-            self._verify_tile(path)
-            arr = np.load(path)
+        path = self.resolve_tile_path(level, tile_idx)
+        if path is not None:
+            arr = self._read_tile_file(path)
             if arr.shape[row_ax] >= off:
                 return arr[keep]
         raise CorruptStoreError(
@@ -595,14 +714,41 @@ class TileStore:
                 return self._tile_dict(level, tail, valid)
             # fall through: a crashed-future complete tile file covers
             # the partial index (its prefix is byte-identical)
+        arr = self._read_tile_file(
+            self.resolve_tile_path(level, tile_idx) or path
+        )
+        return self._tile_dict(level, arr, valid)
+
+    def _read_tile_file(self, path: str) -> np.ndarray:
+        """One tile file's array, whichever format it is in: a
+        ``.tpt`` blob decodes through :mod:`tpudas.codec` (embedded
+        crc verified), a raw ``.npy`` goes through the sidecar gate.
+        A missing file surfaces as ``FileNotFoundError`` (absence is
+        the caller's decision, same as the raw path always was)."""
         fault_point("serve.tile_read", path=path)
-        self._verify_tile(path)
-        arr = np.load(path)
+        if path.endswith(TILE_BLOB_SUFFIX):
+            with open(path, "rb") as fh:
+                blob = fh.read()
+            try:
+                arr = decode_tile(blob)
+            except CodecError as exc:
+                count_fallback(
+                    "tile", f"{type(exc).__name__}: {str(exc)[:120]}",
+                    path,
+                )
+                raise CorruptStoreError(
+                    f"compressed pyramid tile {path!r} failed to "
+                    f"decode ({exc}) — delete {TILE_DIRNAME}/ (or run "
+                    "tools/fsck.py) to rebuild"
+                ) from exc
+        else:
+            self._verify_tile(path)
+            arr = np.load(path)
         get_registry().counter(
             "tpudas_serve_tile_loads_total",
             "pyramid tile files loaded from disk",
         ).inc()
-        return self._tile_dict(level, arr, valid)
+        return arr
 
     @staticmethod
     def _verify_tile(path: str) -> None:
@@ -649,6 +795,35 @@ class TileStore:
         return np.concatenate(parts, axis=0)
 
     # -- appending -----------------------------------------------------
+    def _write_tile(self, level: int, tile_idx: int, arr) -> None:
+        """Write one COMPLETED tile in the store's format: a
+        :mod:`tpudas.codec` blob (crc embedded) under a codec, the
+        legacy checksummed raw ``.npy`` otherwise.  Either way the
+        write is atomic and funnels through the ``fs.write_enospc``
+        fault site, so ENOSPC shedding and the crash drill cover the
+        compressed store identically."""
+        if self.codec is not None:
+            blob = encode_tile(arr, self.codec, **self.codec_params)
+            atomic_write_bytes(self.tile_blob_path(level, tile_idx), blob)
+            return
+        write_npy_checksummed(self.tile_path(level, tile_idx), arr)
+
+    def _condition_rows(self, arr: np.ndarray) -> np.ndarray:
+        """Map rows onto the codec's representable set before they
+        touch tails or tiles (lossy codecs only; identity otherwise).
+        This is what keeps a lossy store deterministic: every stored
+        value roundtrips the codec bit-exactly, so append chunking,
+        crash replay, and offline rebuild all converge on the same
+        bytes — and the error bound holds uniformly, tails included."""
+        if self.codec is None:
+            return arr
+        codec = get_codec(self.codec)
+        if codec.condition is None:
+            return arr
+        return np.ascontiguousarray(
+            codec.condition(arr, **self.codec_params)
+        )
+
     def _append_level(self, level: int, stacked: np.ndarray) -> None:
         """Append rows to one level — ``stacked`` is ``(rows, n_ch)``
         at level 0, ``(3, rows, n_ch)`` (AGGS order) above.  COMPLETED
@@ -683,7 +858,7 @@ class TileStore:
         for j in range(n_full):
             sl = (slice(None),) * row_ax + (slice(j * tl, (j + 1) * tl),)
             tile = np.ascontiguousarray(combined[sl])
-            write_npy_checksummed(self.tile_path(level, base + j), tile)
+            self._write_tile(level, base + j, tile)
             self._wcache[(level, base + j)] = tile
         sl = (slice(None),) * row_ax + (slice(n_full * tl, rows_comb),)
         rem = np.ascontiguousarray(combined[sl])
@@ -752,6 +927,7 @@ class TileStore:
         block = np.full((last + 1 - n0, self.n_ch), np.nan,
                         dtype=_STORE_DTYPE)
         block[idx - n0] = data
+        block = self._condition_rows(block)
         self._wcache.clear()
         self._append_level(0, block)
         self.levels[0] = last + 1
@@ -824,6 +1000,9 @@ class TileStore:
                 ],
                 axis=0,
             )
+            # coarse rows obey the codec's representable set too, so
+            # their later tile encode is exact and chunk-independent
+            reduced = self._condition_rows(reduced)
             self._append_level(lvl + 1, reduced)
             if lvl + 1 < len(self.levels):
                 self.levels[lvl + 1] = n_dst + g
@@ -838,6 +1017,8 @@ def sync_pyramid(
     tile_len: int | None = None,
     engine=None,
     since=None,
+    codec=None,
+    generation: int = 0,
 ) -> int:
     """Bring ``folder``'s tile pyramid up to date with its output
     files; returns the number of level-0 rows appended.
@@ -850,10 +1031,16 @@ def sync_pyramid(
     start (outputs older than it stay full-resolution-only — the
     query engine's file fallback covers them).
 
-    ``factor`` / ``tile_len`` only shape a FRESH pyramid (an existing
-    manifest wins); their defaults come from ``TPUDAS_PYRAMID_FACTOR``
-    / ``TPUDAS_PYRAMID_TILE_LEN`` so an operator can tune tile
-    granularity without touching driver code.
+    ``factor`` / ``tile_len`` / ``codec`` only shape a FRESH pyramid
+    (an existing manifest wins); their defaults come from
+    ``TPUDAS_PYRAMID_FACTOR`` / ``TPUDAS_PYRAMID_TILE_LEN`` /
+    ``TPUDAS_CODEC`` (a codec spec string, e.g.
+    ``bitshuffle-deflate`` or ``quantize-deflate:max_error=1e-3``) so
+    an operator can tune tile granularity and compression without
+    touching driver code.  Re-encoding an EXISTING store is
+    :func:`rebuild_pyramid`'s job — which passes ``generation`` (the
+    bumped cache-invalidation counter) through to the fresh store so
+    its first manifest already carries it.
     """
     from tpudas.io.spool import spool as make_spool
 
@@ -865,11 +1052,17 @@ def sync_pyramid(
         tile_len = int(
             os.environ.get("TPUDAS_PYRAMID_TILE_LEN", _DEFAULT_TILE_LEN)
         )
+    if codec is None:
+        codec = os.environ.get("TPUDAS_CODEC")
     store = TileStore.open(folder, engine=engine)
     if store is None:
         store = TileStore.create(
-            folder, factor=factor, tile_len=tile_len, engine=engine
+            folder, factor=factor, tile_len=tile_len, engine=engine,
+            codec=codec,
         )
+        # non-zero only on the rebuild path: the fresh store's very
+        # first manifest save must already carry the new generation
+        store.generation = int(generation)
     head = store.head_ns
     lo = head
     if lo is None and since is not None:
