@@ -1,0 +1,686 @@
+"""tpudas.backfill: crash-only cluster backfill (ISSUE 12).
+
+Lease claim/renew/steal determinism, exactly-once commit (idempotent
+double-commit, commit-wins), KI-kill at the new ``backfill.claim`` /
+``backfill.commit`` sites plus ``round.body`` with the drained +
+stitched result byte-identical to an uninterrupted control AND to a
+plain sequential realtime run, fatal-shard park, ENOSPC shedding
+inside a shard, drain-mode engine hooks (time cap + bounded ingest
+rounds), and ``audit_backfill`` classify/repair.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from tpudas.backfill import (
+    BackfillQueue,
+    LeaseLostError,
+    load_plan,
+    plan_backfill,
+    run_worker,
+    stitch_backfill,
+)
+from tpudas.backfill.queue import (
+    DONE_DIRNAME,
+    LEASES_DIRNAME,
+    RESULT_DONE_FILENAME,
+    SHARDS_DIRNAME,
+)
+from tpudas.integrity.audit import audit_backfill
+from tpudas.testing import (
+    FaultPlan,
+    FaultSpec,
+    enospc_error,
+    install_fault_plan,
+    make_synthetic_spool,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.crash_drill import (  # noqa: E402
+    _content_hash,
+    _detect_state,
+    _pyramid_tree,
+)
+
+T0 = "2023-03-22T00:00:00"
+FS = 50.0
+FILE_SEC = 20.0
+N_CH = 4
+DT = 1.0
+EDGE = 5.0
+N_FILES = 6  # 120 s archive
+SHARD_SEC = 60.0
+DETECT_OPS = (
+    ("stalta", {"sta": 2.0, "lta": 10.0, "on": 2.0, "off": 1.2}),
+    ("rms", {"window": 5.0, "step": 2.0, "thresh": 1.5,
+             "baseline": 20.0}),
+)
+
+
+def _t_end():
+    return np.datetime64(T0) + np.timedelta64(
+        int(N_FILES * FILE_SEC * 1e9), "ns"
+    )
+
+
+def _plan(root, src, **overrides):
+    kwargs = dict(
+        shard_seconds=SHARD_SEC,
+        output_sample_interval=DT,
+        edge_buffer=EDGE,
+        process_patch_size=20,
+        pyramid=False,
+        detect=False,
+        ingest_limit_sec=35.0,
+    )
+    kwargs.update(overrides)
+    return plan_backfill(root, src, T0, _t_end(), **kwargs)
+
+
+class FakeClock:
+    def __init__(self, t=1_000_000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, sec):
+        self.t += float(sec)
+
+
+@pytest.fixture(scope="module")
+def archive(tmp_path_factory):
+    src = str(tmp_path_factory.mktemp("bf_archive") / "src")
+    make_synthetic_spool(
+        src, n_files=N_FILES, file_duration=FILE_SEC, fs=FS,
+        n_ch=N_CH, noise=0.01, start=np.datetime64(T0),
+    )
+    return src
+
+
+@pytest.fixture(scope="module")
+def sequential_ref(archive, tmp_path_factory):
+    """The oracle: a plain realtime run over the archive with
+    pyramid + detect on."""
+    from tpudas.proc.streaming import run_lowpass_realtime
+
+    out = str(tmp_path_factory.mktemp("bf_seq") / "out")
+    run_lowpass_realtime(
+        source=archive, output_folder=out, start_time=T0,
+        output_sample_interval=DT, edge_buffer=EDGE,
+        process_patch_size=20, poll_interval=0.0,
+        sleep_fn=lambda _s: None, pyramid=True, detect=True,
+        detect_operators=DETECT_OPS,
+    )
+    return out
+
+
+@pytest.fixture(scope="module")
+def control(archive, tmp_path_factory):
+    """The 1-worker uninterrupted control over a full-feature plan."""
+    root = str(tmp_path_factory.mktemp("bf_ctrl") / "root")
+    _plan(root, archive, pyramid=True, detect=True,
+          detect_operators=DETECT_OPS)
+    tally = run_worker(root, worker="ctrl", settle=0.0, max_wall=300)
+    assert tally["stitched"]
+    return root
+
+
+class TestPlan:
+    def test_shard_grid_and_remainder(self, archive, tmp_path):
+        plan = _plan(str(tmp_path / "q"), archive, shard_seconds=50.0)
+        shards = plan["shards"]
+        assert [s["id"] for s in shards] == [
+            f"sh{k:05d}" for k in range(len(shards))
+        ]
+        # contiguous tiling of [t0, t1)
+        assert shards[0]["t0_ns"] == plan["t0_ns"]
+        assert shards[-1]["t1_ns"] == plan["t1_ns"]
+        for a, b in zip(shards, shards[1:]):
+            assert a["t1_ns"] == b["t0_ns"]
+        # leads are plan-derived, grid-rounded, positive
+        assert plan["lead_seconds"] % DT == 0
+        assert plan["tail_seconds"] % DT == 0
+        assert plan["lead_seconds"] > 0 and plan["tail_seconds"] > 0
+
+    def test_plan_is_immutable(self, archive, tmp_path):
+        root = str(tmp_path / "q")
+        _plan(root, archive)
+        with pytest.raises(FileExistsError):
+            _plan(root, archive)
+
+    def test_unknown_config_key_rejected(self, archive, tmp_path):
+        with pytest.raises(ValueError, match="unknown backfill config"):
+            _plan(str(tmp_path / "q"), archive, bogus_knob=1)
+
+    def test_torn_plan_refused(self, archive, tmp_path):
+        root = str(tmp_path / "q")
+        _plan(root, archive)
+        path = os.path.join(root, "backfill.json")
+        with open(path) as fh:
+            payload = json.load(fh)
+        payload["t1_ns"] += 1  # stamp now mismatches
+        with open(path, "w") as fh:
+            json.dump(payload, fh)
+        with pytest.raises(ValueError, match="crc32"):
+            load_plan(root)
+
+
+class TestLease:
+    def _queue(self, root, worker, clock, ttl=30.0):
+        return BackfillQueue(
+            root, worker=worker, lease_ttl=ttl, settle=0.0, clock=clock
+        )
+
+    def test_claim_renew_release(self, archive, tmp_path):
+        root = str(tmp_path / "q")
+        _plan(root, archive)
+        clock = FakeClock()
+        qa = self._queue(root, "wa", clock)
+        qb = self._queue(root, "wb", clock)
+        lease = qa.try_claim("sh00000")
+        assert lease is not None and lease.worker == "wa"
+        assert qa.shard_state("sh00000") == "leased"
+        # a live lease is not claimable by anyone else
+        assert qb.try_claim("sh00000") is None
+        before = qa.read_lease("sh00000")["deadline_ns"]
+        clock.advance(10.0)
+        qa.renew(lease)
+        assert qa.read_lease("sh00000")["deadline_ns"] > before
+        qa.release(lease)
+        assert qa.shard_state("sh00000") == "open"
+
+    def test_stale_lease_is_stolen_and_renew_raises(
+        self, archive, tmp_path
+    ):
+        root = str(tmp_path / "q")
+        _plan(root, archive)
+        clock = FakeClock()
+        qa = self._queue(root, "wa", clock, ttl=5.0)
+        qb = self._queue(root, "wb", clock, ttl=5.0)
+        lease_a = qa.try_claim("sh00000")
+        assert lease_a is not None
+        assert qb.try_claim("sh00000") is None
+        clock.advance(6.0)  # past wa's deadline
+        assert qb.shard_state("sh00000") == "stale"
+        lease_b = qb.try_claim("sh00000")
+        assert lease_b is not None and lease_b.worker == "wb"
+        # the dead worker's resurrection must notice the theft
+        with pytest.raises(LeaseLostError):
+            qa.renew(lease_a)
+        # and its release must not clobber the thief's lease
+        qa.release(lease_a)
+        assert qb.read_lease("sh00000")["worker"] == "wb"
+
+    def test_claim_next_walks_plan_order(self, archive, tmp_path):
+        root = str(tmp_path / "q")
+        _plan(root, archive)
+        clock = FakeClock()
+        qa = self._queue(root, "wa", clock)
+        claimed = [qa.claim_next().shard for _ in range(2)]
+        assert claimed == ["sh00000", "sh00001"]
+
+    def test_settle_reread_detects_lost_race(self, archive, tmp_path):
+        """Two claimers racing one shard: the loser's settle re-read
+        sees the winner's token and backs off (simulated by writing
+        the winner's lease inside the loser's settle window via a
+        zero-settle interleave)."""
+        root = str(tmp_path / "q")
+        _plan(root, archive)
+        clock = FakeClock()
+        qa = self._queue(root, "wa", clock)
+        qb = self._queue(root, "wb", clock)
+        lease_a = qa.try_claim("sh00000")
+        assert lease_a is not None
+        # wb writes over wa's lease directly (the last-write-wins
+        # race), then wa's next renew acts as its settle re-read
+        from tpudas.integrity.checksum import write_json_checksummed
+
+        now = int(clock() * 1e9)
+        write_json_checksummed(
+            os.path.join(root, LEASES_DIRNAME, "sh00000.json"),
+            {
+                "shard": "sh00000", "worker": "wb", "pid": 1,
+                "token": "wb.1.0", "heartbeat_ns": now,
+                "deadline_ns": now + 30_000_000_000, "stolen": False,
+            },
+        )
+        with pytest.raises(LeaseLostError):
+            qa.renew(lease_a)
+
+
+class TestExecuteAndStitch:
+    def test_single_worker_matches_sequential_run(
+        self, control, sequential_ref
+    ):
+        """THE tentpole claim, in-process: a backfill drain + stitch
+        is byte-identical to a single sequential realtime run —
+        merged output content, pyramid tree file-by-file, events
+        ledger bytes, score tiles, parsed detect carry."""
+        res = os.path.join(control, "result")
+        assert _content_hash(res) == _content_hash(sequential_ref)
+        assert _pyramid_tree(res) == _pyramid_tree(sequential_ref)
+        assert _detect_state(res) == _detect_state(sequential_ref)
+
+    def test_drain_uses_bounded_rounds(self, archive, control):
+        """ingest_limit_sec chunks the drain into multiple bounded
+        rounds (the lease-renewal cadence) — visible in the done
+        markers' round counts."""
+        from tpudas.integrity.checksum import read_json_verified
+
+        done = os.path.join(control, DONE_DIRNAME)
+        rounds = []
+        for name in sorted(os.listdir(done)):
+            payload, _ = read_json_verified(
+                os.path.join(done, name), "backfill_done"
+            )
+            rounds.append(payload.get("rounds", 0))
+        assert rounds and all(r >= 1 for r in rounds)
+
+    def test_kill_at_claim_commit_round_then_resume_identical(
+        self, archive, control, tmp_path
+    ):
+        """KeyboardInterrupt (the in-process SIGKILL stand-in — it
+        bypasses every ``except Exception``) at backfill.claim,
+        backfill.commit, and round.body in three successive worker
+        incarnations; a fourth clean worker drains what is left.  The
+        stitched result must be byte-identical to the uninterrupted
+        control."""
+        root = str(tmp_path / "q")
+        _plan(root, archive, pyramid=True, detect=True,
+              detect_operators=DETECT_OPS)
+        clock = FakeClock()
+        kill_sites = ("backfill.claim", "backfill.commit", "round.body")
+        for i, site in enumerate(kill_sites):
+            plan = FaultPlan(
+                FaultSpec(site, exc=KeyboardInterrupt, at=i + 1)
+            )
+            with install_fault_plan(plan):
+                with pytest.raises(KeyboardInterrupt):
+                    run_worker(
+                        root, worker=f"w{i}", settle=0.0,
+                        lease_ttl=5.0, clock=clock, max_wall=300,
+                    )
+            assert plan.fired, site
+            clock.advance(6.0)  # the dead worker's lease goes stale
+        tally = run_worker(
+            root, worker="wfinal", settle=0.0, lease_ttl=5.0,
+            clock=clock, max_wall=300,
+        )
+        assert tally["stitched"], tally
+        report = audit_backfill(root, repair=True, clock=clock)
+        assert report["clean"], report["issues"]
+        res = os.path.join(root, "result")
+        ctrl_res = os.path.join(control, "result")
+        assert _content_hash(res) == _content_hash(ctrl_res)
+        assert _pyramid_tree(res) == _pyramid_tree(ctrl_res)
+        assert _detect_state(res) == _detect_state(ctrl_res)
+
+    def test_double_commit_is_idempotent(self, archive, tmp_path):
+        """Worker A drains a shard and dies just before its commit;
+        worker B reclaims, re-executes, commits.  A's resurrected
+        commit must LOSE (commit-wins), discard its staging, and
+        leave B's done marker byte-identical."""
+        from tpudas.backfill.runner import execute_shard
+
+        root = str(tmp_path / "q")
+        _plan(root, archive)
+        clock = FakeClock()
+        qa = BackfillQueue(
+            root, worker="wa", settle=0.0, lease_ttl=5.0, clock=clock
+        )
+        lease_a = qa.try_claim("sh00000")
+        plan = FaultPlan(
+            FaultSpec("backfill.commit", exc=KeyboardInterrupt, at=1)
+        )
+        with install_fault_plan(plan):
+            with pytest.raises(KeyboardInterrupt):
+                execute_shard(qa, lease_a, sleep_fn=lambda _s: None)
+        staging_a = qa.staging_dir(lease_a)
+        assert os.path.isdir(staging_a)  # fully drained, uncommitted
+        clock.advance(6.0)
+        qb = BackfillQueue(
+            root, worker="wb", settle=0.0, lease_ttl=5.0, clock=clock
+        )
+        lease_b = qb.try_claim("sh00000")
+        assert lease_b is not None
+        assert execute_shard(
+            qb, lease_b, sleep_fn=lambda _s: None
+        ) == "committed"
+        done_path = os.path.join(root, DONE_DIRNAME, "sh00000.json")
+        with open(done_path, "rb") as fh:
+            marker_before = fh.read()
+        # A comes back from the dead and retries ITS commit
+        outcome = qa.commit(lease_a, staging_a)
+        assert outcome == "lost"
+        assert not os.path.isdir(staging_a)  # discarded, not merged
+        with open(done_path, "rb") as fh:
+            assert fh.read() == marker_before  # B's commit stands
+        assert qb.is_done("sh00000")
+
+    def test_fatal_shard_parks_queue_still_drains(
+        self, archive, tmp_path
+    ):
+        """A fatal failure inside one shard's drain parks THAT shard
+        (counted, fsck-able); the worker commits the rest and the
+        stitch refuses until an operator clears the park."""
+        root = str(tmp_path / "q")
+        _plan(root, archive)
+        clock = FakeClock()
+        plan = FaultPlan(
+            FaultSpec("round.body", exc=ValueError("bad shard"), at=1)
+        )
+        with install_fault_plan(plan):
+            tally = run_worker(
+                root, worker="w0", settle=0.0, lease_ttl=5.0,
+                clock=clock, max_wall=300,
+            )
+        assert tally["parked"] == 1
+        assert tally["committed"] == 1  # the other shard drained
+        assert not tally["stitched"]
+        assert tally.get("stitch_status") is None
+        queue = BackfillQueue(root, worker="chk", clock=clock)
+        counts = queue.counts()
+        assert counts["parked"] == 1 and counts["done"] == 1
+        result = stitch_backfill(root, queue=queue)
+        assert result["status"] == "unstitchable"
+        report = audit_backfill(root, repair=True, clock=clock)
+        assert report["parked"] == ["sh00000"]
+        # operator repair: clear the park, re-drain, stitch lands
+        os.remove(os.path.join(root, ".parked", "sh00000.json"))
+        tally2 = run_worker(
+            root, worker="w1", settle=0.0, lease_ttl=5.0,
+            clock=clock, max_wall=300,
+        )
+        assert tally2["committed"] == 1 and tally2["stitched"]
+
+    def test_enospc_inside_shard_sheds_then_commits(
+        self, archive, control, tmp_path
+    ):
+        """A full disk mid-shard (injected at the carry save) rides
+        the resource retry ladder — crash-equivalent retry, shed
+        writers — and the shard still commits with the stitched bytes
+        matching the control."""
+        root = str(tmp_path / "q")
+        _plan(root, archive, pyramid=True, detect=True,
+              detect_operators=DETECT_OPS)
+        clock = FakeClock()
+        plan = FaultPlan(
+            FaultSpec("carry.save", exc=enospc_error(), at=1, times=2)
+        )
+        with install_fault_plan(plan):
+            tally = run_worker(
+                root, worker="w0", settle=0.0, lease_ttl=30.0,
+                clock=clock, max_wall=300, sleep_fn=lambda _s: None,
+            )
+        assert plan.fired
+        assert tally["stitched"], tally
+        assert tally["parked"] == 0
+        res = os.path.join(root, "result")
+        ctrl_res = os.path.join(control, "result")
+        assert _content_hash(res) == _content_hash(ctrl_res)
+        assert _pyramid_tree(res) == _pyramid_tree(ctrl_res)
+
+    def test_adoption_finishes_a_crashed_commit(self, archive, tmp_path):
+        """The crash window between the commit rename and the done
+        marker: the next claimer adopts the committed directory
+        instead of re-executing."""
+        root = str(tmp_path / "q")
+        _plan(root, archive)
+        clock = FakeClock()
+        run_worker(
+            root, worker="w0", settle=0.0, lease_ttl=5.0, clock=clock,
+            stitch=False, max_wall=300,
+        )
+        # simulate the crash window: drop one done marker
+        os.remove(os.path.join(root, DONE_DIRNAME, "sh00001.json"))
+        queue = BackfillQueue(
+            root, worker="w1", settle=0.0, clock=clock
+        )
+        assert queue.shard_state("sh00001") == "adoptable"
+        tally = run_worker(
+            root, worker="w1", settle=0.0, lease_ttl=5.0, clock=clock,
+            max_wall=300,
+        )
+        assert tally["adopted"] == 1
+        assert queue.is_done("sh00001")
+
+
+class TestDrainModeHooks:
+    def test_time_range_caps_ingest(self, archive, tmp_path):
+        """The engine's drain-mode cap: a runner with time_range set
+        never emits past the cap (plus the held-back edge)."""
+        from tpudas.backfill.runner import shard_spec
+        from tpudas.fleet.engine import LowpassStreamRunner, drive
+
+        root = str(tmp_path / "q")
+        plan = _plan(root, archive)
+        out = str(tmp_path / "out")
+        cap_ns = plan["shards"][0]["t1_ns"]
+        runner = LowpassStreamRunner(
+            shard_spec(plan, plan["shards"][0]), out
+        )
+        runner.time_range = (None, np.datetime64(int(cap_ns), "ns"))
+        drive(runner, sleep_fn=lambda _s: None)
+        sp_hash_rows = []
+        from tpudas.io.spool import spool as make_spool
+
+        sp = make_spool(out).sort("time").update()
+        for p in sp.chunk(time=None):
+            ts = (
+                np.asarray(p.coords["time"])
+                .astype("datetime64[ns]")
+                .astype(np.int64)
+            )
+            sp_hash_rows.append(ts)
+        assert sp_hash_rows, "shard drain emitted nothing"
+        assert int(np.concatenate(sp_hash_rows).max()) < cap_ns
+
+    def test_ingest_limit_bounds_rounds(self, archive, tmp_path):
+        """ingest_limit_sec chunks a static-archive drain into
+        multiple rounds instead of one unbounded one, and the
+        no-growth terminate still fires at the end."""
+        from tpudas.backfill.runner import shard_spec
+        from tpudas.fleet.engine import LowpassStreamRunner, drive
+
+        root = str(tmp_path / "q")
+        plan = _plan(root, archive)
+        out = str(tmp_path / "out")
+        runner = LowpassStreamRunner(
+            shard_spec(plan, plan["shards"][0]), out
+        )
+        runner.ingest_limit_sec = 30.0
+        drive(runner, sleep_fn=lambda _s: None)
+        assert runner.rounds >= 2  # the 60 s shard took >= 2 bites
+
+
+class TestAuditBackfill:
+    def _drained(self, archive, tmp_path, name="q"):
+        root = str(tmp_path / name)
+        _plan(root, archive)
+        clock = FakeClock()
+        run_worker(
+            root, worker="w0", settle=0.0, lease_ttl=5.0, clock=clock,
+            max_wall=300,
+        )
+        return root, clock
+
+    def test_stale_lease_and_orphan_staging_swept(
+        self, archive, tmp_path
+    ):
+        root, clock = self._drained(archive, tmp_path)
+        # fabricate a dead worker's leftovers: a stale lease + staging
+        from tpudas.integrity.checksum import write_json_checksummed
+
+        now = int(clock() * 1e9)
+        write_json_checksummed(
+            os.path.join(root, LEASES_DIRNAME, "sh00001.json"),
+            {
+                "shard": "sh00001", "worker": "dead", "pid": 1,
+                "token": "dead.1.0", "heartbeat_ns": now,
+                "deadline_ns": now - 1, "stolen": False,
+            },
+        )
+        orphan = os.path.join(
+            root, SHARDS_DIRNAME, "sh00001.work.dead.1.0"
+        )
+        os.makedirs(orphan)
+        report = audit_backfill(root, repair=True, clock=clock)
+        assert report["clean"], report["issues"]
+        statuses = {
+            (i["artifact"], i["status"]) for i in report["issues"]
+        }
+        assert ("backfill_lease", "stale_lease") in statuses
+        assert ("backfill_staging", "orphan") in statuses
+        assert not os.path.isdir(orphan)
+        # second audit: nothing left
+        report2 = audit_backfill(root, repair=True, clock=clock)
+        assert report2["clean"] and not report2["issues"]
+
+    def test_live_lease_and_its_staging_left_alone(
+        self, archive, tmp_path
+    ):
+        root, clock = self._drained(archive, tmp_path)
+        from tpudas.integrity.checksum import write_json_checksummed
+
+        os.remove(os.path.join(root, DONE_DIRNAME, "sh00001.json"))
+        import shutil
+
+        shutil.rmtree(os.path.join(root, SHARDS_DIRNAME, "sh00001"))
+        now = int(clock() * 1e9)
+        write_json_checksummed(
+            os.path.join(root, LEASES_DIRNAME, "sh00001.json"),
+            {
+                "shard": "sh00001", "worker": "alive", "pid": 1,
+                "token": "alive.1.0", "heartbeat_ns": now,
+                "deadline_ns": now + 60_000_000_000, "stolen": False,
+            },
+        )
+        live = os.path.join(
+            root, SHARDS_DIRNAME, "sh00001.work.alive.1.0"
+        )
+        os.makedirs(live)
+        report = audit_backfill(root, repair=True, clock=clock)
+        assert os.path.isdir(live)  # a live claim's staging survives
+        paths = {i["path"] for i in report["issues"]}
+        assert live not in paths
+
+    def test_commit_crash_window_adopted(self, archive, tmp_path):
+        root, clock = self._drained(archive, tmp_path)
+        os.remove(os.path.join(root, DONE_DIRNAME, "sh00000.json"))
+        report = audit_backfill(root, repair=True, clock=clock)
+        assert report["clean"], report["issues"]
+        actions = {i["action"] for i in report["issues"]}
+        assert "adopted_commit" in actions
+        queue = BackfillQueue(root, worker="chk", clock=clock)
+        assert queue.is_done("sh00000")
+
+    def test_torn_done_marker_removed_then_adopted(
+        self, archive, tmp_path
+    ):
+        root, clock = self._drained(archive, tmp_path)
+        path = os.path.join(root, DONE_DIRNAME, "sh00000.json")
+        with open(path, "r+") as fh:
+            fh.seek(0)
+            fh.write('{"shard": "XX"')  # torn mid-write
+        report = audit_backfill(root, repair=True, clock=clock)
+        assert report["clean"], report["issues"]
+        queue = BackfillQueue(root, worker="chk", clock=clock)
+        assert queue.is_done("sh00000")  # re-adopted from the bytes
+
+    def test_half_stitched_result_removed(self, archive, tmp_path):
+        root, clock = self._drained(archive, tmp_path)
+        clock2 = clock
+        stitch_backfill(
+            root,
+            queue=BackfillQueue(
+                root, worker="st", settle=0.0, clock=clock2
+            ),
+        )
+        # the crash window between the result rename and its marker
+        os.remove(os.path.join(root, RESULT_DONE_FILENAME))
+        report = audit_backfill(root, repair=True, clock=clock)
+        assert report["clean"], report["issues"]
+        assert not os.path.isdir(os.path.join(root, "result"))
+        # a re-stitch rebuilds it deterministically
+        result = stitch_backfill(
+            root,
+            queue=BackfillQueue(
+                root, worker="st2", settle=0.0, clock=clock2
+            ),
+        )
+        assert result["status"] == "committed"
+
+    def test_unreadable_plan_is_not_clean(self, tmp_path):
+        root = str(tmp_path / "q")
+        os.makedirs(root)
+        with open(os.path.join(root, "backfill.json"), "w") as fh:
+            fh.write("{")
+        report = audit_backfill(root, repair=True)
+        assert not report["clean"]
+        assert "unreadable backfill plan" in report["error"]
+
+
+class TestCommitWindowRegression:
+    """Review findings (PR 12): the stitch crash window must be
+    adoptable, and a live lease over a committed directory must not
+    be clobbered by a concurrent adopter."""
+
+    def test_marker_less_result_adopted_not_lost(
+        self, archive, tmp_path
+    ):
+        root = str(tmp_path / "q")
+        _plan(root, archive)
+        clock = FakeClock()
+        run_worker(
+            root, worker="w0", settle=0.0, lease_ttl=5.0, clock=clock,
+            max_wall=300,
+        )
+        # the crash window: rename landed, marker write never did
+        os.remove(os.path.join(root, RESULT_DONE_FILENAME))
+        result = stitch_backfill(
+            root,
+            queue=BackfillQueue(
+                root, worker="st", settle=0.0, clock=clock
+            ),
+        )
+        assert result["status"] == "committed"
+        assert result.get("adopted") is True
+        assert os.path.isfile(os.path.join(root, RESULT_DONE_FILENAME))
+        # and the queue reads as fully stitched from here on
+        again = stitch_backfill(
+            root,
+            queue=BackfillQueue(
+                root, worker="st2", settle=0.0, clock=clock
+            ),
+        )
+        assert again["status"] == "already"
+
+    def test_live_lease_protects_commit_window(self, archive, tmp_path):
+        """A committed directory whose lease is still LIVE is a worker
+        inside its commit (between rename and marker): it must read
+        as leased, never adoptable."""
+        root = str(tmp_path / "q")
+        _plan(root, archive)
+        clock = FakeClock()
+        qa = BackfillQueue(
+            root, worker="wa", settle=0.0, lease_ttl=30.0, clock=clock
+        )
+        lease = qa.try_claim("sh00000")
+        assert lease is not None
+        os.makedirs(qa.shard_dir("sh00000"))
+        qb = BackfillQueue(
+            root, worker="wb", settle=0.0, lease_ttl=30.0, clock=clock
+        )
+        assert qb.shard_state("sh00000") == "leased"
+        assert qb.try_claim("sh00000") is None
+        # once the lease expires the window is adoptable
+        clock.advance(31.0)
+        assert qb.shard_state("sh00000") == "adoptable"

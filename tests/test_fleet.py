@@ -635,3 +635,106 @@ class TestFleetDrillSmoke:
         assert all(
             s["ok"] for s in rep["streams_match"].values()
         )
+
+
+class TestFleetUnpark:
+    def test_parked_stream_rejoins_after_probe(self, tmp_path):
+        """ISSUE 12 satellite: with unpark_probe set, a stream parked
+        on a transient-looking fatal is re-probed on a doubling
+        schedule, rebuilt from disk, and finishes — the fleet summary
+        shows it terminated, not parked."""
+        root = str(tmp_path / "root")
+        specs = []
+        for sid in ("s0", "s1", "s2"):
+            src = str(tmp_path / f"src_{sid}")
+            _feed(src, 0, 1)
+            specs.append(
+                StreamSpec(
+                    stream_id=sid, source=src,
+                    config=_lowpass_config(
+                        poll_jitter=0.0, health=True
+                    ),
+                )
+            )
+        # hit 2 of round.body = the second stream served in window 0;
+        # ONE fatal hit — the unpark probe's rebuilt runner runs clean
+        plan = FaultPlan(
+            FaultSpec(
+                "round.body", exc=ValueError("transient-looking"), at=2
+            )
+        )
+        eng = FleetEngine(
+            root, specs, sleep_fn=lambda _s: None, unpark_probe=1.0
+        )
+        with install_fault_plan(plan):
+            summary = eng.run()
+        assert summary["parked"] == []
+        assert summary["unparked_total"] == 1
+        for sid in ("s0", "s1", "s2"):
+            assert summary["streams"][sid]["status"] == "terminated"
+            assert summary["streams"][sid]["rounds"] == 1
+        unparked = [
+            sid for sid, s in summary["streams"].items()
+            if s["unparks"]
+        ]
+        assert len(unparked) == 1
+        # the park/unpark transition is visible in health.json
+        health_path = os.path.join(root, unparked[0], "health.json")
+        with open(health_path) as fh:
+            payload = json.load(fh)
+        assert payload["fleet"]["event"] == "unparked"
+        assert payload["fleet"]["unparks"] == 1
+
+    def test_probes_exhaust_to_terminal_park(self, tmp_path):
+        """A stream that keeps dying fatally exhausts its probe
+        budget (doubling intervals, bounded attempts) and stays
+        parked — run() still terminates."""
+        root = str(tmp_path / "root")
+        src = str(tmp_path / "src")
+        _feed(src, 0, 1)
+        specs = [
+            StreamSpec(
+                stream_id="s0", source=src,
+                config=_lowpass_config(poll_jitter=0.0, health=True),
+            )
+        ]
+        plan = FaultPlan(
+            FaultSpec(
+                "round.body", exc=ValueError("still broken"), at=1,
+                times=1000,
+            )
+        )
+        eng = FleetEngine(
+            root, specs, sleep_fn=lambda _s: None,
+            unpark_probe=0.5, unpark_max_probes=2,
+        )
+        with install_fault_plan(plan):
+            summary = eng.run()
+        assert summary["parked"] == ["s0"]
+        assert summary["streams"]["s0"]["unparks"] == 2
+        assert "still broken" in summary["streams"]["s0"]["error"]
+        # the terminal health snapshot records the park event
+        with open(os.path.join(root, "s0", "health.json")) as fh:
+            payload = json.load(fh)
+        assert payload["fleet"]["event"] == "parked"
+
+    def test_default_park_stays_terminal(self, tmp_path):
+        """Without unpark_probe (the default) parking keeps its
+        pre-ISSUE-12 terminal semantics."""
+        root = str(tmp_path / "root")
+        src = str(tmp_path / "src")
+        _feed(src, 0, 1)
+        specs = [
+            StreamSpec(
+                stream_id="s0", source=src,
+                config=_lowpass_config(poll_jitter=0.0),
+            )
+        ]
+        plan = FaultPlan(
+            FaultSpec("round.body", exc=ValueError("fatal"), at=1)
+        )
+        eng = FleetEngine(root, specs, sleep_fn=lambda _s: None)
+        with install_fault_plan(plan):
+            summary = eng.run()
+        assert summary["parked"] == ["s0"]
+        assert summary["unparked_total"] == 0

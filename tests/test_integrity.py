@@ -876,6 +876,42 @@ class TestCrashDrill:
         assert rep["ok"], rep
 
 
+class TestBackfillDrill:
+    def test_smoke_two_workers_two_kills(self):
+        """Tier-1 smoke of the cluster-backfill chaos drill
+        (ISSUE 12): 2 worker processes against one queue, 2 seeded
+        SIGKILLs plus injected claim/commit faults — the drained
+        queue audits clean and the stitched result is byte-identical
+        to a 1-worker uninterrupted control AND to a plain sequential
+        realtime run.  The N=4 / >=6-kill acceptance drill runs under
+        ``-m slow`` (and as the tools/backfill_drill.py CLI default,
+        recorded in BENCH_pr12.json)."""
+        from tools.backfill_drill import run_backfill_drill
+
+        rep = run_backfill_drill(workers=2, kills=2, shards=4, seed=3)
+        assert rep["kills"] >= 1, rep
+        assert rep["audit_clean"], rep
+        assert rep["parked"] == [], rep
+        for key in (
+            "outputs_match_control",
+            "pyramid_match_control",
+            "detect_match_control",
+            "outputs_match_sequential",
+            "pyramid_match_sequential",
+            "detect_match_sequential",
+        ):
+            assert rep[key], (key, rep)
+        assert rep["ok"], rep
+
+    @pytest.mark.slow
+    def test_full_backfill_drill(self):
+        from tools.backfill_drill import run_backfill_drill
+
+        rep = run_backfill_drill(workers=4, kills=6, shards=8, seed=0)
+        assert rep["kills"] >= 6, rep
+        assert rep["ok"], rep
+
+
 # ---------------------------------------------------------------------------
 # health schema v3 integration
 

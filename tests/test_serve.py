@@ -727,3 +727,77 @@ class TestToolingLint:
         assert any("serve.request" in p for p in problems)
         # default (partial-source) mode stays quiet
         assert check_metrics.lint({"f.py": ""}, catalog_text="") == []
+
+
+class TestServePoolRespawn:
+    def test_dead_worker_is_respawned(self, streamed):
+        """ISSUE 12 satellite: a SIGKILLed data-plane worker is
+        respawned by the supervision loop (bounded restarts, counted)
+        instead of permanently shrinking the pool — /pool/healthz
+        goes degraded during the gap and back to ok after."""
+        import signal
+        import time as _t
+        import urllib.request
+
+        from tpudas.serve.pool import ServePool, has_reuse_port
+
+        if not has_reuse_port():
+            pytest.skip("SO_REUSEPORT unavailable on this platform")
+        _src, out = streamed
+        pool = ServePool(
+            out, port=0, workers=2, restart_backoff=0.05
+        )
+        with pool:
+            assert pool.health()["status"] == "ok"
+            victim_pid = pool.worker_info[0]["pid"]
+            os.kill(victim_pid, signal.SIGKILL)
+            deadline = _t.time() + 30
+            while _t.time() < deadline:
+                h = pool.health()
+                if (
+                    h["status"] == "ok"
+                    and h["workers"]["0"]["pid"] != victim_pid
+                ):
+                    break
+                _t.sleep(0.1)
+            else:
+                pytest.fail(f"worker 0 never respawned: {pool.health()}")
+            assert pool.restart_counts().get(0, 0) >= 1
+            # the respawned worker serves on the shared port again
+            body = urllib.request.urlopen(
+                pool.control_url + "/metrics", timeout=30
+            ).read().decode()
+            assert "tpudas_serve_pool_worker_restarts_total" in body
+
+    def test_restarts_are_bounded(self, tmp_path):
+        """A worker that can never come up stops being respawned
+        after max_restarts (the pool reports degraded, not a spawn
+        storm)."""
+        from tpudas.serve.pool import ServePool, has_reuse_port
+
+        if not has_reuse_port():
+            pytest.skip("SO_REUSEPORT unavailable on this platform")
+        out = str(tmp_path / "store")
+        os.makedirs(out)
+        pool = ServePool(
+            out, port=0, workers=1, restart_backoff=0.01,
+            max_restarts=2,
+        )
+        with pool:
+            # kill it repeatedly until the restart budget is spent
+            import signal
+            import time as _t
+
+            deadline = _t.time() + 30
+            while _t.time() < deadline:
+                if pool.restart_counts().get(0, 0) >= 2:
+                    break
+                pid = pool.worker_info[0]["pid"]
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+                _t.sleep(0.1)
+            # give the monitor a beat: count must CAP at max_restarts
+            _t.sleep(0.6)
+            assert pool.restart_counts().get(0, 0) == 2

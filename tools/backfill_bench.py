@@ -1,0 +1,197 @@
+"""Backfill scaling bench: wall time over worker count for a
+multi-hour synthetic archive (BENCH_pr12.json).
+
+The embarrassingly-parallel second workload every future perf PR can
+bench against (ROADMAP item 5): one archive, one plan per run, N
+worker subprocesses draining the queue.  Records:
+
+- the worker-count scaling curve (wall seconds + speedup vs 1 worker
+  for the DRAIN phase, stitch reported separately — the stitch is a
+  single-writer tail by design);
+- the lease/claim/renew/commit overhead fraction summed from the done
+  markers (acceptance budget: < 2% of shard wall);
+- cross-N result digests (every worker count must produce the same
+  stitched bytes — scaling must not buy divergence).
+
+CLI::
+
+    JAX_PLATFORMS=cpu python tools/backfill_bench.py \
+        [--hours 2.0] [--workers 1,2,4] [--shard-sec 600] [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+T0 = "2023-03-22T00:00:00"
+FS = 50.0
+FILE_SEC = 20.0
+N_CH = 8
+DT_OUT = 1.0
+EDGE_SEC = 5.0
+PATCH_OUT = 40
+
+
+def _bench_one(workdir, src, n_files, shard_sec, n_workers,
+               log_fh=None) -> dict:
+    import numpy as np
+
+    from tools.backfill_drill import _spawn
+    from tpudas.backfill import BackfillQueue, plan_backfill
+    from tpudas.backfill.queue import RESULT_DONE_FILENAME
+    from tpudas.integrity.audit import audit_backfill
+
+    root = os.path.join(workdir, f"queue_w{n_workers}")
+    t_end = np.datetime64(T0) + np.timedelta64(
+        int(n_files * FILE_SEC * 1e9), "ns"
+    )
+    plan = plan_backfill(
+        root, src, T0, t_end, shard_seconds=float(shard_sec),
+        output_sample_interval=DT_OUT, edge_buffer=EDGE_SEC,
+        process_patch_size=PATCH_OUT, pyramid=True, detect=False,
+        ingest_limit_sec=120.0,
+    )
+    queue = BackfillQueue(root, worker="bench-parent", settle=0.0)
+    t0 = time.time()
+    # a 5 ms claim settle is ample local-FS write visibility; the
+    # drill keeps 20 ms (it races real SIGKILLs over slower paths)
+    procs = [
+        _spawn(root, f"b{i:02d}", "", log_fh, settle=0.005)
+        for i in range(n_workers)
+    ]
+    t_drained = None
+    while True:
+        if t_drained is None and queue.all_done():
+            t_drained = time.time()
+        if all(p.poll() is not None for p in procs):
+            break
+        if time.time() - t0 > 3600:
+            for p in procs:
+                p.kill()
+            raise TimeoutError("backfill bench run exceeded 1h")
+        time.sleep(0.1)
+    t_done = time.time()
+    if t_drained is None:
+        t_drained = t_done
+    for p in procs:
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"bench worker exited rc={p.returncode} (see --log)"
+            )
+    if not os.path.isfile(os.path.join(root, RESULT_DONE_FILENAME)):
+        raise RuntimeError("bench queue drained but never stitched")
+    report = audit_backfill(root, repair=True)
+    from tools.backfill_drill import _overhead_fraction
+    from tools.crash_drill import _content_hash, _pyramid_tree
+
+    over_s, wall_s = _overhead_fraction(root)
+    res = os.path.join(root, "result")
+    return {
+        "workers": int(n_workers),
+        "shards": len(plan["shards"]),
+        "drain_wall_s": round(t_drained - t0, 3),
+        "total_wall_s": round(t_done - t0, 3),
+        "shard_wall_sum_s": round(wall_s, 3),
+        "overhead_s": round(over_s, 4),
+        "overhead_fraction": (
+            round(over_s / wall_s, 5) if wall_s else None
+        ),
+        "audit_clean": bool(report["clean"]),
+        "result_content_sha": _content_hash(res),
+        "result_pyramid_files": len(_pyramid_tree(res)),
+    }
+
+
+def run_bench(hours=2.0, workers=(1, 2, 4), shard_sec=600.0,
+              workdir=None, log_path=None) -> dict:
+    from tools.backfill_drill import _build_archive
+
+    workdir = workdir or tempfile.mkdtemp(prefix="backfill_bench_")
+    src = os.path.join(workdir, "src")
+    n_files = int(round(hours * 3600.0 / FILE_SEC))
+    log_fh = open(log_path, "ab") if log_path else None
+    try:
+        import numpy as np
+
+        from tpudas.testing import make_synthetic_spool
+
+        make_synthetic_spool(
+            src, n_files=n_files, file_duration=FILE_SEC, fs=FS,
+            n_ch=N_CH, noise=0.01, start=np.datetime64(T0),
+        )
+        runs = []
+        for n in workers:
+            print(f"backfill_bench: workers={n} ...")
+            runs.append(
+                _bench_one(workdir, src, n_files, shard_sec, int(n),
+                           log_fh)
+            )
+            r = runs[-1]
+            print(
+                f"backfill_bench: workers={n} drain={r['drain_wall_s']}s "
+                f"overhead={r['overhead_fraction']}"
+            )
+        base = runs[0]["drain_wall_s"]
+        for r in runs:
+            r["speedup_vs_1"] = round(base / r["drain_wall_s"], 3)
+        shas = {r["result_content_sha"] for r in runs}
+        return {
+            "archive_hours": float(hours),
+            "archive_files": n_files,
+            "channels": N_CH,
+            "fs_hz": FS,
+            "shard_seconds": float(shard_sec),
+            "runs": runs,
+            "results_identical_across_workers": len(shas) == 1,
+            "max_overhead_fraction": max(
+                r["overhead_fraction"] or 0.0 for r in runs
+            ),
+            "ok": bool(
+                len(shas) == 1
+                and all(r["audit_clean"] for r in runs)
+                and max(
+                    r["overhead_fraction"] or 0.0 for r in runs
+                ) < 0.02
+            ),
+            "workdir": workdir,
+        }
+    finally:
+        if log_fh is not None:
+            log_fh.close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--hours", type=float, default=2.0)
+    ap.add_argument("--workers", default="1,2,4")
+    ap.add_argument("--shard-sec", type=float, default=600.0)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--log", default=None)
+    args = ap.parse_args(argv)
+    rep = run_bench(
+        hours=args.hours,
+        workers=[int(w) for w in args.workers.split(",") if w],
+        shard_sec=args.shard_sec,
+        log_path=args.log,
+    )
+    print(json.dumps(
+        {k: v for k, v in rep.items() if k != "workdir"}, indent=1
+    ))
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(rep, fh, indent=1)
+    print(f"backfill_bench: {'OK' if rep['ok'] else 'FAILED'}")
+    return 0 if rep["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

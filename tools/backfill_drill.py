@@ -1,0 +1,398 @@
+"""Multi-worker backfill chaos drill: SIGKILL + injected claim/commit
+faults against one shared queue, then prove exactly-once came true.
+
+The cluster-scale sibling of ``tools/crash_drill.py`` (ISSUE 12): one
+synthetic archive, one :mod:`tpudas.backfill` queue, N worker
+subprocesses draining it concurrently.  The parent:
+
+1. plans the queue over a seeded synthetic archive;
+2. runs a 1-worker **uninterrupted control** (separate root, same
+   plan) and a plain **sequential reference** (the realtime driver
+   with pyramid + detect over the same archive);
+3. keeps N chaos workers alive against the drill root, SIGKILLing a
+   seeded-random live worker ``kills`` times (kill timers start at
+   worker READY, so kills land in claim/drain/commit windows, not in
+   ``import jax``), and handing every third/fourth spawn an injected
+   fault plan that raises at ``backfill.claim`` / ``backfill.commit``
+   — a worker dying at the two nastiest protocol points;
+4. respawns replacements until every shard is committed and the
+   stitch lands (stale leases from killed workers must be reclaimed
+   by the survivors — that IS the mechanism under test);
+5. asserts ``audit_backfill`` is **clean** and the drill's stitched
+   result is **byte-identical** to both the 1-worker control (merged
+   output content, pyramid tree file-by-file, events-ledger bytes,
+   score tiles, parsed detect carry) and the sequential reference;
+6. reports the lease/claim/renew/commit overhead fraction from the
+   done markers (the <2%-of-shard-wall acceptance budget).
+
+CLI (the acceptance drill — BENCH_pr12.json records a run)::
+
+    JAX_PLATFORMS=cpu python tools/backfill_drill.py \
+        [--workers 4] [--kills 6] [--shards 8] [--seed 0] [--out PATH]
+
+``tests/test_integrity.py`` runs a 2-worker/2-kill smoke in tier-1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+T0 = "2023-03-22T00:00:00"
+FS = 50.0
+FILE_SEC = 20.0
+N_CH = 4
+DT_OUT = 1.0
+EDGE_SEC = 5.0
+PATCH_OUT = 20
+SHARD_SEC = 60.0
+LEASE_TTL = 15.0
+DETECT_OPS = (
+    ("stalta", {"sta": 2.0, "lta": 10.0, "on": 2.0, "off": 1.2}),
+    ("rms", {"window": 5.0, "step": 2.0, "thresh": 1.5,
+             "baseline": 20.0}),
+)
+
+
+# ---------------------------------------------------------------------------
+# the worker subprocess
+
+def _worker_main(root: str, worker_id: str, fault: str,
+                 settle: float = 0.02) -> int:
+    """One chaos worker: optionally install an injected fault plan
+    (``site:at[xN]`` — an uncaught raise at a claim/commit protocol
+    point, i.e. a worker dying there), mark READY, drain the queue."""
+    from tpudas.backfill import run_worker
+    from tpudas.resilience.faults import (
+        FaultPlan,
+        FaultSpec,
+        install_fault_plan,
+    )
+
+    ready_dir = os.path.join(root, ".workers")
+    os.makedirs(ready_dir, exist_ok=True)
+    if fault:
+        site, _, rest = fault.partition(":")
+        at, _, times = rest.partition("x")
+        install_fault_plan(
+            FaultPlan(
+                FaultSpec(
+                    site, "raise", at=int(at or 1),
+                    times=int(times or 1),
+                )
+            )
+        )
+    with open(os.path.join(ready_dir, worker_id + ".ready"), "w") as fh:
+        fh.write(str(os.getpid()))
+    run_worker(
+        root, worker=worker_id, stitch=True,
+        lease_ttl=LEASE_TTL, settle=float(settle), idle_poll=0.1,
+    )
+    return 0
+
+
+def _spawn(root, worker_id, fault="", log_fh=None, settle=0.02):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault(
+        "TPUDAS_COMPILE_CACHE",
+        os.path.join(os.path.dirname(root), "xla_cache"),
+    )
+    proc = subprocess.Popen(
+        [
+            sys.executable, os.path.abspath(__file__),
+            "--worker", root, worker_id, fault, str(settle),
+        ],
+        env=env,
+        stdout=log_fh if log_fh is not None else subprocess.DEVNULL,
+        stderr=subprocess.STDOUT if log_fh is not None else (
+            subprocess.DEVNULL
+        ),
+    )
+    return proc
+
+
+def _ready(root, worker_id) -> bool:
+    return os.path.isfile(
+        os.path.join(root, ".workers", worker_id + ".ready")
+    )
+
+
+# ---------------------------------------------------------------------------
+# the parent harness
+
+def _build_archive(src: str, n_files: int) -> None:
+    import numpy as np
+
+    from tpudas.testing import make_synthetic_spool
+
+    make_synthetic_spool(
+        src, n_files=n_files, file_duration=FILE_SEC, fs=FS,
+        n_ch=N_CH, noise=0.01, start=np.datetime64(T0),
+    )
+
+
+def _plan(root: str, src: str, n_files: int) -> dict:
+    import numpy as np
+
+    from tpudas.backfill import plan_backfill
+
+    t_end = np.datetime64(T0) + np.timedelta64(
+        int(n_files * FILE_SEC * 1e9), "ns"
+    )
+    return plan_backfill(
+        root, src, T0, t_end, shard_seconds=SHARD_SEC,
+        output_sample_interval=DT_OUT, edge_buffer=EDGE_SEC,
+        process_patch_size=PATCH_OUT, pyramid=True, detect=True,
+        detect_operators=DETECT_OPS, ingest_limit_sec=40.0,
+    )
+
+
+def _overhead_fraction(root: str) -> tuple:
+    """(overhead_s, shard_wall_s) summed over the done markers."""
+    from tpudas.backfill.queue import DONE_DIRNAME
+    from tpudas.integrity.checksum import read_json_verified
+
+    done_dir = os.path.join(root, DONE_DIRNAME)
+    over = wall = 0.0
+    for name in sorted(os.listdir(done_dir)):
+        if not name.endswith(".json"):
+            continue
+        try:
+            payload, _ = read_json_verified(
+                os.path.join(done_dir, name), "backfill_done"
+            )
+        except (OSError, ValueError):
+            continue
+        over += float(payload.get("overhead_s", 0.0))
+        wall += float(payload.get("wall_s", 0.0))
+    return over, wall
+
+
+def run_backfill_drill(
+    workers: int = 4,
+    kills: int = 6,
+    shards: int = 8,
+    seed: int = 0,
+    workdir: str | None = None,
+    log_path: str | None = None,
+    max_wall: float = 1200.0,
+) -> dict:
+    """One full chaos drill; returns the report dict with ``ok`` True
+    when the audit is clean and every byte-identity comparison holds."""
+    import numpy as np
+
+    from tools.crash_drill import (
+        _content_hash,
+        _detect_state,
+        _pyramid_tree,
+    )
+    from tpudas.backfill import BackfillQueue, run_worker
+    from tpudas.integrity.audit import audit_backfill
+
+    workers = int(workers)
+    n_files = int(round(shards * SHARD_SEC / FILE_SEC))
+    workdir = workdir or tempfile.mkdtemp(
+        prefix=f"backfill_drill_w{workers}_"
+    )
+    src = os.path.join(workdir, "src")
+    root = os.path.join(workdir, "queue")
+    ctrl_root = os.path.join(workdir, "ctrl")
+    seq = os.path.join(workdir, "seq")
+    log_fh = open(log_path, "ab") if log_path else None
+    try:
+        _build_archive(src, n_files)
+        _plan(root, src, n_files)
+        _plan(ctrl_root, src, n_files)
+        # the 1-worker uninterrupted control (in-process, no faults)
+        t0 = time.time()
+        run_worker(
+            ctrl_root, worker="ctrl", settle=0.0,
+            lease_ttl=LEASE_TTL, max_wall=max_wall,
+        )
+        ctrl_wall = time.time() - t0
+        # the sequential reference: the realtime driver, pyramid +
+        # detect on — the stitched result must match a LIVE run too
+        from tpudas.proc.streaming import run_lowpass_realtime
+
+        run_lowpass_realtime(
+            source=src, output_folder=seq, start_time=T0,
+            output_sample_interval=DT_OUT, edge_buffer=EDGE_SEC,
+            process_patch_size=PATCH_OUT, poll_interval=0.0,
+            sleep_fn=lambda _s: None, pyramid=True, detect=True,
+            detect_operators=DETECT_OPS,
+        )
+        # chaos: keep `workers` live against the queue, kill on a
+        # seeded schedule, hand every 3rd spawn a claim fault and
+        # every 4th a commit fault (an uncaught raise = a worker
+        # dying at the protocol's nastiest points)
+        rng = np.random.default_rng(seed)
+        est = max(ctrl_wall / max(shards, 1), 0.4)
+        queue = BackfillQueue(root, worker="parent", settle=0.0)
+        procs: dict = {}
+        spawn_i = 0
+        kills_done = 0
+        faults_injected = []
+        deadline = time.time() + max_wall
+
+        def spawn_one():
+            nonlocal spawn_i
+            wid = f"w{spawn_i:03d}"
+            fault = ""
+            if spawn_i % 3 == 1:
+                fault = f"backfill.claim:{int(rng.integers(1, 4))}"
+            elif spawn_i % 4 == 2:
+                fault = f"backfill.commit:{int(rng.integers(1, 3))}"
+            if fault:
+                faults_injected.append(f"{wid}={fault}")
+            procs[wid] = _spawn(root, wid, fault, log_fh)
+            spawn_i += 1
+
+        for _ in range(workers):
+            spawn_one()
+        while True:
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"backfill drill exceeded {max_wall}s; queue "
+                    f"counts {queue.counts()}"
+                )
+            for wid in list(procs):
+                if procs[wid].poll() is not None:
+                    del procs[wid]
+            resolved = queue.resolved()
+            stitched = os.path.isfile(
+                os.path.join(root, "result.done.json")
+            )
+            if resolved and stitched and not procs:
+                break
+            if resolved and stitched:
+                time.sleep(0.1)
+                continue
+            if kills_done < kills and procs:
+                live_ready = [w for w in sorted(procs) if _ready(root, w)]
+                if live_ready:
+                    victim = live_ready[
+                        int(rng.integers(0, len(live_ready)))
+                    ]
+                    time.sleep(float(rng.uniform(0.05, est)))
+                    if procs[victim].poll() is None:
+                        os.kill(procs[victim].pid, signal.SIGKILL)
+                        procs[victim].wait()
+                        kills_done += 1
+                    del procs[victim]
+            # keep the pool at strength until the queue resolves AND
+            # the stitch lands — a kill landing on the last live
+            # worker mid-stitch must still get a successor (which
+            # adopts or re-stitches)
+            while len(procs) < workers and not (resolved and stitched):
+                spawn_one()
+            time.sleep(0.05)
+        # a final clean pass picks up anything the last kill dropped
+        # (also exercises the "nothing to do" worker path)
+        final = run_worker(
+            root, worker="final", settle=0.0, lease_ttl=LEASE_TTL,
+            max_wall=max_wall,
+        )
+        report = audit_backfill(root, repair=True)
+        res = os.path.join(root, "result")
+        ctrl_res = os.path.join(ctrl_root, "result")
+        over_s, wall_s = _overhead_fraction(root)
+        comp = {
+            "outputs_match_control": (
+                _content_hash(res) == _content_hash(ctrl_res)
+            ),
+            "pyramid_match_control": (
+                _pyramid_tree(res) == _pyramid_tree(ctrl_res)
+            ),
+            "detect_match_control": (
+                _detect_state(res) == _detect_state(ctrl_res)
+            ),
+            "outputs_match_sequential": (
+                _content_hash(res) == _content_hash(seq)
+            ),
+            "pyramid_match_sequential": (
+                _pyramid_tree(res) == _pyramid_tree(seq)
+            ),
+            "detect_match_sequential": (
+                _detect_state(res) == _detect_state(seq)
+            ),
+        }
+        ok = bool(
+            report["clean"]
+            and not report["parked"]
+            and all(comp.values())
+            and kills_done >= min(kills, 1)
+        )
+        return {
+            "workers": workers,
+            "kills": kills_done,
+            "kills_requested": int(kills),
+            "shards": int(shards),
+            "seed": int(seed),
+            "spawns": spawn_i,
+            "faults_injected": faults_injected,
+            "audit_clean": bool(report["clean"]),
+            "audit_issues": report["issues_total"],
+            "parked": report["parked"],
+            **comp,
+            "final_worker": {
+                k: final[k]
+                for k in ("committed", "adopted", "lost", "parked")
+            },
+            "overhead_s": round(over_s, 4),
+            "shard_wall_s": round(wall_s, 4),
+            "overhead_fraction": (
+                round(over_s / wall_s, 5) if wall_s else None
+            ),
+            "ctrl_wall_s": round(ctrl_wall, 3),
+            "workdir": workdir,
+            "ok": ok,
+        }
+    finally:
+        if log_fh is not None:
+            log_fh.close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--kills", type=int, default=6)
+    ap.add_argument("--shards", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=None, help="write JSON report here")
+    ap.add_argument("--log", default=None, help="worker stdout log file")
+    args = ap.parse_args(argv)
+    rep = run_backfill_drill(
+        workers=args.workers, kills=args.kills, shards=args.shards,
+        seed=args.seed, log_path=args.log,
+    )
+    print(json.dumps(
+        {k: v for k, v in rep.items() if k != "workdir"}, indent=1
+    ))
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(rep, fh, indent=1)
+    print(f"backfill_drill: {'OK' if rep['ok'] else 'FAILED'}")
+    return 0 if rep["ok"] else 1
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 4 and sys.argv[1] == "--worker":
+        sys.exit(
+            _worker_main(
+                sys.argv[2], sys.argv[3],
+                sys.argv[4] if len(sys.argv) > 4 else "",
+                float(sys.argv[5]) if len(sys.argv) > 5 else 0.02,
+            )
+        )
+    sys.exit(main())

@@ -114,6 +114,21 @@ REQUIRED_METRICS = (
     "tpudas_serve_cache_tiles",
     "tpudas_serve_pool_workers",
     "tpudas_serve_pool_worker_unreachable_total",
+    # cluster backfill (PR 12): tools/backfill_drill.py and
+    # tools/backfill_bench.py read these by name; the RESILIENCE.md
+    # "Cluster backfill" runbook points dashboards at them
+    "tpudas_backfill_shards",
+    "tpudas_backfill_shards_committed_total",
+    "tpudas_backfill_shards_reclaimed_total",
+    "tpudas_backfill_shards_parked_total",
+    "tpudas_backfill_claim_conflicts_total",
+    "tpudas_backfill_double_commits_total",
+    "tpudas_backfill_lease_renewals_total",
+    "tpudas_backfill_overhead_seconds_total",
+    "tpudas_backfill_shard_seconds",
+    "tpudas_backfill_stitch_rows_total",
+    "tpudas_serve_pool_worker_restarts_total",
+    "tpudas_fleet_unparked_total",
 )
 REQUIRED_SPANS = (
     "serve.request",
@@ -131,6 +146,11 @@ REQUIRED_SPANS = (
     "codec.encode",
     "codec.decode",
     "serve.pool_merge",
+    "backfill.claim",
+    "backfill.commit",
+    "backfill.shard",
+    "backfill.stitch",
+    "backfill.audit",
 )
 
 

@@ -16,6 +16,11 @@ Options:
     --fleet         treat the folder as a fleet root: audit every
                     <root>/<stream_id>/ independently and aggregate
                     (tpudas.integrity.audit.audit_fleet, FLEET.md)
+    --backfill      treat the folder as a backfill queue root: sweep
+                    stale leases / orphan stagings, finish crashed
+                    commits, audit committed shards + the stitched
+                    result (tpudas.integrity.audit.audit_backfill,
+                    RESILIENCE.md "Cluster backfill")
     --out PATH      also write the JSON report to PATH
 
 Run only while the driver is stopped: the stale-tmp sweep cannot tell
@@ -52,16 +57,29 @@ def main(argv=None) -> int:
         "--fleet", action="store_true",
         help="audit every <folder>/<stream_id>/ as a fleet root",
     )
+    ap.add_argument(
+        "--backfill", action="store_true",
+        help="audit the folder as a tpudas.backfill queue root",
+    )
     ap.add_argument("--out", default=None, help="write JSON report here")
     args = ap.parse_args(argv)
+    if args.fleet and args.backfill:
+        ap.error("--fleet and --backfill are mutually exclusive")
 
-    from tpudas.integrity.audit import audit, audit_fleet
+    from tpudas.integrity.audit import audit, audit_backfill, audit_fleet
 
-    report = (audit_fleet if args.fleet else audit)(
-        args.folder,
-        repair=not args.no_repair,
-        rebuild=not args.no_rebuild,
-    )
+    if args.backfill:
+        report = audit_backfill(
+            args.folder,
+            repair=not args.no_repair,
+            rebuild=not args.no_rebuild,
+        )
+    else:
+        report = (audit_fleet if args.fleet else audit)(
+            args.folder,
+            repair=not args.no_repair,
+            rebuild=not args.no_rebuild,
+        )
     text = json.dumps(report, indent=1)
     print(text)
     if args.out:

@@ -1,0 +1,45 @@
+"""tpudas.backfill — crash-only cluster backfill over a shared filesystem.
+
+The batch half of the paper's workload (reprocess months of archived
+spool with a new filter plan, new detect operators, or a codec
+re-encode) executed by N concurrent worker processes/hosts with
+exactly-once shard commit:
+
+- :mod:`tpudas.backfill.queue` — the filesystem-backed work queue of
+  time-shard jobs with crc-stamped manifests and lease-based claiming
+  (stale leases are reclaimed by any worker; double execution resolves
+  by the commit-wins atomic rename);
+- :mod:`tpudas.backfill.runner` — drain-mode shard execution reusing
+  :class:`tpudas.fleet.engine.LowpassStreamRunner` (poll loop replaced
+  by drain-as-fast-as-possible over the slice) with the full fault
+  ladder, ENOSPC shedding, and fatal-shard parking;
+- :mod:`tpudas.backfill.stitch` — deterministic stitching of the
+  committed shard outputs into a result byte-identical to a single
+  sequential run (pyramid synced, detect ledger/scores recomputed
+  chunk-invariantly).
+
+``tools/backfill_drill.py`` is the chaos harness (N workers, seeded
+SIGKILLs, injected claim/commit faults); ``tools/backfill_bench.py``
+records the worker-count scaling curve.  See RESILIENCE.md, "Cluster
+backfill".
+"""
+
+from tpudas.backfill.queue import (  # noqa: F401
+    BackfillQueue,
+    Lease,
+    LeaseLostError,
+    load_plan,
+    plan_backfill,
+)
+from tpudas.backfill.runner import run_worker  # noqa: F401
+from tpudas.backfill.stitch import stitch_backfill  # noqa: F401
+
+__all__ = [
+    "BackfillQueue",
+    "Lease",
+    "LeaseLostError",
+    "load_plan",
+    "plan_backfill",
+    "run_worker",
+    "stitch_backfill",
+]

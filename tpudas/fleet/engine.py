@@ -159,6 +159,10 @@ class _EdgeHealth:
         # snapshot (and through /healthz) as a "detect" sub-object;
         # not part of the required schema, absent when detect is off
         self.detect = None
+        # optional extra sub-objects (e.g. the fleet's park/unpark
+        # event record) merged into every snapshot — same
+        # schema-optional status as the detect sub-object
+        self.extra: dict = {}
         self._fb0 = fallback_count()  # run baseline for the delta
 
     def integrity_fallbacks(self) -> int:
@@ -179,9 +183,9 @@ class _EdgeHealth:
             or res_degraded
             or fallbacks > 0
         )
-        payload_extra = (
-            {} if self.detect is None else {"detect": self.detect}
-        )
+        payload_extra = dict(self.extra)
+        if self.detect is not None:
+            payload_extra["detect"] = self.detect
         write_health(
             self.folder,
             {
@@ -408,6 +412,15 @@ class StreamRunner:
             resolve_poll_jitter(spec.config.poll_jitter),
         )
         self.interval = 0.0  # subclasses set the clamped poll cadence
+        # drain-mode hooks (tpudas.backfill): a time cap on the source
+        # slice this runner may ingest, and a bound on the data-seconds
+        # one round may consume (so a multi-hour archive shard drains
+        # in lease-renewable chunks instead of one unbounded round).
+        # Run control, not configuration — set by whoever drives the
+        # rounds, like max_rounds/sleep_fn.
+        self.time_range = None  # (lo, hi) numpy datetime64 or None
+        self.ingest_limit_sec = None  # max data-seconds per round
+        self._more_to_drain = False  # last round hit the ingest limit
 
     def poll_delay(self) -> float:
         """The advisory wait before the next poll: the clamped
@@ -573,11 +586,14 @@ class LowpassStreamRunner(StreamRunner):
                 if self.distance is not None
                 else sp
             )
+            if self.time_range is not None:
+                sub = sub.select(time=self.time_range)
             n_now = len(sub)
             if (
                 self.len_last is not None
                 and n_now == self.len_last
                 and self.boundary.consecutive == 0
+                and not self._more_to_drain
             ):
                 print("No new data was detected. Real-time processing ended successfully.")
                 return StepResult("terminate")
@@ -671,6 +687,23 @@ class LowpassStreamRunner(StreamRunner):
         # newest timestamp from the index — no file data is read
         contents = sub.get_contents()
         t2 = np.datetime64(contents["time_max"].max())
+        # drain-mode clamps (tpudas.backfill): never ingest past the
+        # slice cap, and never more than ingest_limit_sec of data in
+        # one round (bounded rounds keep the shard lease renewable)
+        self._more_to_drain = False
+        if self.time_range is not None and self.time_range[1] is not None:
+            hi = np.datetime64(self.time_range[1], "ns")
+            t2 = min(t2, hi)
+        if self.ingest_limit_sec is not None and self.stateful:
+            base = None
+            if self.carry is not None and self.carry.next_ingest_ns is not None:
+                base = np.datetime64(int(self.carry.next_ingest_ns), "ns")
+            else:
+                base = np.datetime64(self.start_time, "ns")
+            cap2 = base + to_timedelta64(float(self.ingest_limit_sec))
+            if cap2 < t2:
+                t2 = cap2
+                self._more_to_drain = True
         redundant = 0.0
         if self.stateful:
             # carried state: only NEW samples are read/filtered

@@ -72,7 +72,8 @@ class _FleetStream:
 
     __slots__ = (
         "spec", "runner", "status", "error", "next_due", "deficit",
-        "steps", "wall_seconds",
+        "steps", "wall_seconds", "probe_due", "probe_interval",
+        "probes", "unparks",
     )
 
     def __init__(self, spec: StreamSpec, runner: StreamRunner | None):
@@ -84,6 +85,12 @@ class _FleetStream:
         self.deficit = 0.0
         self.steps = 0
         self.wall_seconds = 0.0
+        # unpark probe state (ISSUE 12): parked streams may re-probe
+        # on a slow doubling schedule (mirrors the quarantine probe)
+        self.probe_due = None  # virtual seconds; None = no probe
+        self.probe_interval = None
+        self.probes = 0
+        self.unparks = 0
 
     @property
     def stream_id(self) -> str:
@@ -114,6 +121,19 @@ class FleetEngine:
     on_round:
         Optional ``on_round(stream_id, round, lfp)`` callback
         (lowpass streams only, matching the driver hook).
+    unpark_probe:
+        Seconds until a PARKED stream's first re-probe (None, the
+        default, keeps parking terminal for the process lifetime —
+        the pre-ISSUE-12 behavior).  When set, a parked stream is
+        re-probed on a doubling-interval schedule (mirroring the
+        quarantine probe policy): the probe rebuilds the runner from
+        disk — crash-only, so a stream parked on a transient-looking
+        fatal (disk briefly full, a config file mid-edit) rejoins the
+        fleet where it left off.  A failed probe doubles the
+        interval; after ``unpark_max_probes`` failures the park is
+        terminal.  Successful unparks are counted
+        (``tpudas_fleet_unparked_total``) and both transitions leave
+        a ``fleet`` park/unpark event in the stream's health.json.
     """
 
     def __init__(
@@ -126,6 +146,8 @@ class FleetEngine:
         deficit_cap: float = _DEFICIT_CAP_SEC,
         default_poll_jitter: float = DEFAULT_POLL_JITTER,
         on_round=None,
+        unpark_probe: float | None = None,
+        unpark_max_probes: int = 6,
     ):
         import os
 
@@ -142,6 +164,11 @@ class FleetEngine:
         self.sleep_fn = sleep_fn
         self.quantum = float(quantum)
         self.deficit_cap = float(deficit_cap)
+        self.unpark_probe = (
+            None if unpark_probe is None else float(unpark_probe)
+        )
+        self.unpark_max_probes = int(unpark_max_probes)
+        self._on_round = on_round
         self.now = 0.0  # virtual seconds since run start
         self.sched_seconds = 0.0  # wall spent in scheduler bookkeeping
         # (stream_id, status, wall) per step, bounded so a months-long
@@ -173,18 +200,7 @@ class FleetEngine:
             # as step(): a stream that cannot even build is PARKED, the
             # fleet still serves the others
             try:
-                runner = build_runner(
-                    spec,
-                    root=self.root,
-                    counters=Counters(),
-                    on_round=(
-                        None if on_round is None else (
-                            lambda rnd, lfp, _sid=str(spec.stream_id): (
-                                on_round(_sid, rnd, lfp)
-                            )
-                        )
-                    ),
-                )
+                runner = self._build_runner(spec)
             except Exception as exc:
                 s = _FleetStream(spec, None)
                 self.streams[s.stream_id] = s
@@ -196,6 +212,21 @@ class FleetEngine:
             "streams configured in the fleet engine",
         ).set(len(self.streams))
         self._state_gauges()
+
+    def _build_runner(self, spec: StreamSpec) -> StreamRunner:
+        on_round = self._on_round
+        return build_runner(
+            spec,
+            root=self.root,
+            counters=Counters(),
+            on_round=(
+                None if on_round is None else (
+                    lambda rnd, lfp, _sid=str(spec.stream_id): (
+                        on_round(_sid, rnd, lfp)
+                    )
+                )
+            ),
+        )
 
     # -- scheduling ------------------------------------------------------
     def _state_gauges(self) -> None:
@@ -236,7 +267,26 @@ class FleetEngine:
     def _park(self, s: _FleetStream, exc: BaseException) -> None:
         s.status = "parked"
         s.error = f"{type(exc).__name__}: {str(exc)[:300]}"
+        # schedule the unpark re-probe (doubling interval, bounded
+        # attempts — the quarantine probe policy, stream-sized)
+        if self.unpark_probe is not None and (
+            s.probes < self.unpark_max_probes
+        ):
+            s.probe_interval = (
+                self.unpark_probe if s.probe_interval is None
+                else s.probe_interval * 2.0
+            )
+            s.probe_due = self.now + s.probe_interval
+        else:
+            s.probe_due = None
         if s.runner is not None:
+            health = getattr(s.runner, "edge_health", None)
+            if health is not None:
+                health.extra["fleet"] = {
+                    "event": "parked",
+                    "unparks": s.unparks,
+                    "error": s.error,
+                }
             try:
                 s.runner.record_fatal(exc)
             except Exception as exc2:
@@ -255,6 +305,53 @@ class FleetEngine:
         )
         self._state_gauges()
 
+    def _try_unpark(self, s: _FleetStream) -> bool:
+        """One unpark probe: rebuild the runner from disk (crash-only
+        resume — carry/ledger/pyramid say where to continue).  A
+        failed rebuild doubles the probe interval; success puts the
+        stream back in the rotation immediately."""
+        s.probes += 1
+        try:
+            runner = self._build_runner(s.spec)
+        except Exception as exc:
+            s.error = f"{type(exc).__name__}: {str(exc)[:300]}"
+            if s.probes >= self.unpark_max_probes:
+                s.probe_due = None  # terminal: probes exhausted
+            else:
+                s.probe_interval *= 2.0
+                s.probe_due = self.now + s.probe_interval
+            log_event(
+                "fleet_unpark_probe_failed",
+                stream=s.stream_id,
+                probe=s.probes,
+                error=s.error,
+            )
+            return False
+        s.runner = runner
+        s.status = "active"
+        s.error = None
+        s.next_due = self.now
+        s.deficit = 0.0
+        s.probe_due = None
+        s.unparks += 1
+        health = getattr(runner, "edge_health", None)
+        if health is not None:
+            health.extra["fleet"] = {
+                "event": "unparked",
+                "unparks": s.unparks,
+                "probes": s.probes,
+            }
+        get_registry().counter(
+            "tpudas_fleet_unparked_total",
+            "parked streams that rejoined the fleet via the unpark "
+            "re-probe",
+        ).inc()
+        log_event(
+            "fleet_stream_unparked", stream=s.stream_id, probe=s.probes
+        )
+        self._state_gauges()
+        return True
+
     def run(self) -> dict:
         """Serve every stream until it terminates (spool stopped
         growing), hits the ``max_rounds`` poll cap, or parks on a
@@ -266,12 +363,31 @@ class FleetEngine:
             while True:
                 t_sched = _time.perf_counter()
                 active = self._active()
-                if not active:
+                probing = (
+                    [
+                        s for s in self.streams.values()
+                        if s.status == "parked" and s.probe_due is not None
+                    ]
+                    if self.unpark_probe is not None else []
+                )
+                if not active and not probing:
                     self.sched_seconds += _time.perf_counter() - t_sched
                     break
+                probe_due = [s for s in probing if s.probe_due <= self.now]
+                if probe_due:
+                    # probes are cheap and rare: serve them before the
+                    # deficit rotation (an unparked stream then joins
+                    # the due set on this same pass)
+                    self.sched_seconds += _time.perf_counter() - t_sched
+                    for s in probe_due:
+                        self._try_unpark(s)
+                    continue
                 due = [s for s in active if s.next_due <= self.now]
                 if not due:
-                    wait = min(s.next_due for s in active) - self.now
+                    wait = min(
+                        [s.next_due for s in active]
+                        + [s.probe_due for s in probing]
+                    ) - self.now
                     self.sched_seconds += _time.perf_counter() - t_sched
                     self.sleep_fn(max(wait, 0.0))
                     self.now += max(wait, 0.0)
@@ -341,6 +457,7 @@ class FleetEngine:
                     3,
                 ),
                 "head_lag_seconds": getattr(r, "head_lag", None),
+                "unparks": s.unparks,
                 "error": s.error,
             }
         return {
@@ -353,6 +470,9 @@ class FleetEngine:
             "parked": sorted(
                 sid for sid, s in self.streams.items()
                 if s.status == "parked"
+            ),
+            "unparked_total": sum(
+                s.unparks for s in self.streams.values()
             ),
             "sched_seconds": round(self.sched_seconds, 4),
             "wall_seconds": (

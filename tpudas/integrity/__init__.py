@@ -29,7 +29,7 @@ See RESILIENCE.md ("Integrity & recovery") for formats, the ladder,
 and the fsck / crash-drill runbook.
 """
 
-from tpudas.integrity.audit import audit
+from tpudas.integrity.audit import audit, audit_backfill, audit_fleet
 from tpudas.integrity.checksum import (
     CRC_KEY,
     SIDECAR_SUFFIX,
@@ -55,6 +55,8 @@ __all__ = [
     "RESOURCE_ERRNOS",
     "SIDECAR_SUFFIX",
     "audit",
+    "audit_backfill",
+    "audit_fleet",
     "crc32_hex",
     "fallback_count",
     "is_degraded",

@@ -87,7 +87,7 @@ from tpudas.obs.trace import span
 from tpudas.utils.atomicio import is_tmp_name
 from tpudas.utils.logging import log_event
 
-__all__ = ["audit", "audit_fleet", "fleet_stream_dirs"]
+__all__ = ["audit", "audit_backfill", "audit_fleet", "fleet_stream_dirs"]
 
 _TILE_NAME_RE = re.compile(r"^(\d{8})\.npy$")
 # compressed pyramid tiles (tpudas.codec blobs, ISSUE 11): the crc is
@@ -916,6 +916,7 @@ _REPAIRED_ACTIONS = (
     "rebuilt_pyramid",
     "reset_detect",
     "truncated",
+    "adopted_commit",
 )
 
 
@@ -1053,5 +1054,302 @@ def audit_fleet(root, repair: bool = True, rebuild: bool = True) -> dict:
             clean=report["clean"],
             streams=len(streams),
             repaired=repaired_total,
+        )
+    return report
+
+
+# ---------------------------------------------------------------------------
+# backfill queue fsck (tpudas.backfill, ISSUE 12)
+
+_STAGING_NAME_RE = re.compile(r"^(sh\d{5}|result)\.work\..+$")
+
+
+def _backfill_shard_check(
+    root, shard_id, queue, issues, repair, clock, rebuild=True
+) -> None:
+    """One shard's queue-side state: lease, done marker, committed
+    directory, crash windows between them."""
+    from tpudas.backfill.queue import DONE_DIRNAME, LEASES_DIRNAME
+
+    lease_path = os.path.join(root, LEASES_DIRNAME, shard_id + ".json")
+    done_path = os.path.join(root, DONE_DIRNAME, shard_id + ".json")
+    sdir = queue.shard_dir(shard_id)
+    done = queue.is_done(shard_id)
+    # -- the done marker ------------------------------------------------
+    if os.path.isfile(done_path) and not done:
+        # torn marker: remove it — the committed directory (if any) is
+        # re-adopted below, an absent one re-executes
+        if repair:
+            _remove_all(done_path)
+        _issue(
+            issues, "backfill_done", done_path, "torn",
+            _repair_action(repair, "removed"), "crc32 mismatch",
+        )
+        done = False
+    if done and not os.path.isdir(sdir):
+        # a marker with no bytes behind it can only mislead the stitch
+        if repair:
+            _remove_all(done_path)
+        _issue(
+            issues, "backfill_done", done_path, "corrupt",
+            _repair_action(repair, "removed"),
+            "done marker without a committed shard directory",
+        )
+        done = False
+    # -- the lease ------------------------------------------------------
+    if os.path.isfile(lease_path):
+        lease = queue.read_lease(shard_id)
+        now_ns = int(float(clock()) * 1e9)
+        if lease is None:
+            if repair:
+                _remove_all(lease_path)
+            _issue(
+                issues, "backfill_lease", lease_path, "torn",
+                _repair_action(repair, "removed"), "unparseable lease",
+            )
+        elif done:
+            if repair:
+                _remove_all(lease_path)
+            _issue(
+                issues, "backfill_lease", lease_path, "stale_lease",
+                _repair_action(repair, "removed"),
+                "lease outlived its shard's commit",
+            )
+        elif int(lease.get("deadline_ns", 0)) < now_ns:
+            if repair:
+                _remove_all(lease_path)
+            _issue(
+                issues, "backfill_lease", lease_path, "stale_lease",
+                _repair_action(repair, "removed"),
+                f"deadline passed (worker {lease.get('worker')!r})",
+            )
+    # -- a committed directory without its marker -----------------------
+    if os.path.isdir(sdir) and not done and not queue.is_parked(shard_id):
+        # the crash window between the commit rename and the marker
+        # write: verify the directory and adopt it (exactly what a
+        # claiming worker would do)
+        sub = audit(sdir, repair=repair, rebuild=rebuild)
+        if sub["clean"]:
+            if repair:
+                from tpudas.backfill.queue import Lease
+
+                queue._write_done(
+                    shard_id,
+                    Lease(shard=shard_id, token="fsck", worker="fsck"),
+                    {"adopted": True},
+                )
+            _issue(
+                issues, "backfill_commit", sdir, "torn",
+                _repair_action(repair, "adopted_commit"),
+                "committed directory without a done marker",
+            )
+        else:
+            if repair:
+                import shutil
+
+                shutil.rmtree(sdir, ignore_errors=True)
+            _issue(
+                issues, "backfill_commit", sdir, "corrupt",
+                _repair_action(repair, "removed"),
+                "unverifiable committed directory (re-executes)",
+            )
+
+
+def audit_backfill(root, repair: bool = True, rebuild: bool = True,
+                   clock=time.time) -> dict:
+    """Fsck one backfill queue root (tpudas.backfill): verify the
+    plan, sweep stale/torn leases and orphan staging directories,
+    finish crashed commits (committed directory without its marker →
+    verified + adopted; torn/bodiless done markers → removed so the
+    shard re-executes), audit every committed shard and the stitched
+    result with the standard per-folder :func:`audit`, and classify a
+    half-stitched result.  Parked shards are REPORTED (counted, never
+    "repaired" — re-running a parked shard is an operator decision).
+
+    Run only while no worker is active on the root — live staging
+    directories are distinguishable from orphans only by their lease,
+    and the lease of a mid-drain worker may renew between our read
+    and the sweep."""
+    from tpudas.backfill.queue import (
+        PARKED_DIRNAME,
+        RESULT_DIRNAME,
+        RESULT_DONE_FILENAME,
+        SHARDS_DIRNAME,
+        BackfillQueue,
+    )
+
+    root = str(root)
+    t0 = time.perf_counter()
+    issues: list = []
+    shard_reports: dict = {}
+    parked: list = []
+    error = None
+    with span("backfill.audit", root=root):
+        try:
+            queue = BackfillQueue(root, worker="fsck", clock=clock)
+        except Exception as exc:
+            queue = None
+            error = (
+                f"unreadable backfill plan: {type(exc).__name__}: "
+                f"{str(exc)[:200]}"
+            )
+            log_event(
+                "backfill_audit_plan_unreadable",
+                root=root,
+                error=error,
+            )
+            _issue(
+                issues, "backfill_plan",
+                os.path.join(root, "backfill.json"), "corrupt",
+                "failed", error,
+            )
+        if queue is not None:
+            from tpudas.backfill.queue import (
+                DONE_DIRNAME,
+                LEASES_DIRNAME,
+            )
+
+            # crashed bookkeeping writers leave tmp files beside the
+            # leases/markers; sweep them (shard/result directories get
+            # their own full audit below, tmp sweep included)
+            for d in (LEASES_DIRNAME, DONE_DIRNAME, PARKED_DIRNAME):
+                p = os.path.join(root, d)
+                if os.path.isdir(p):
+                    _sweep_tmp(p, issues, repair)
+            shard_ids = [sh["id"] for sh in queue.plan["shards"]]
+            live_tokens = set()
+            for sid in shard_ids:
+                lease = queue.read_lease(sid)
+                now_ns = int(float(clock()) * 1e9)
+                if (
+                    lease is not None
+                    and int(lease.get("deadline_ns", 0)) >= now_ns
+                    and not queue.is_done(sid)
+                ):
+                    live_tokens.add(str(lease.get("token")))
+                _backfill_shard_check(
+                    root, sid, queue, issues, repair, clock,
+                    rebuild=rebuild,
+                )
+                if queue.is_parked(sid):
+                    parked.append(sid)
+                if queue.is_done(sid):
+                    shard_reports[sid] = audit(
+                        queue.shard_dir(sid), repair=repair,
+                        rebuild=rebuild,
+                    )
+            # orphan staging sweep: shard and result work dirs whose
+            # token no live lease references (their writer is gone —
+            # crashed, reclaimed, or lost the commit race)
+            shards_dir = os.path.join(root, SHARDS_DIRNAME)
+            candidates = []
+            if os.path.isdir(shards_dir):
+                candidates += [
+                    os.path.join(shards_dir, n)
+                    for n in sorted(os.listdir(shards_dir))
+                ]
+            candidates += [
+                os.path.join(root, n) for n in sorted(os.listdir(root))
+            ]
+            for path in candidates:
+                name = os.path.basename(path)
+                m = _STAGING_NAME_RE.match(name)
+                if m is None or not os.path.isdir(path):
+                    continue
+                token = name.split(".work.", 1)[1]
+                if token in live_tokens:
+                    continue
+                if repair:
+                    import shutil
+
+                    shutil.rmtree(path, ignore_errors=True)
+                _issue(
+                    issues, "backfill_staging", path, "orphan",
+                    _repair_action(repair, "removed"),
+                    "staging directory with no live lease",
+                )
+            # the stitched result: half-committed states + a standard
+            # per-folder audit of a committed one
+            result_dir = os.path.join(root, RESULT_DIRNAME)
+            done_path = os.path.join(root, RESULT_DONE_FILENAME)
+            result_done = False
+            if os.path.isfile(done_path):
+                try:
+                    _, status = read_json_verified(
+                        done_path, "backfill_result"
+                    )
+                    result_done = status != "mismatch"
+                except (OSError, ValueError):
+                    result_done = False
+                if not result_done:
+                    if repair:
+                        _remove_all(done_path)
+                    _issue(
+                        issues, "backfill_result", done_path, "torn",
+                        _repair_action(repair, "removed"),
+                        "unreadable result marker",
+                    )
+            if os.path.isdir(result_dir):
+                if result_done:
+                    shard_reports["result"] = audit(
+                        result_dir, repair=repair, rebuild=rebuild,
+                    )
+                else:
+                    # rename landed, marker missing: the stitch is a
+                    # deterministic pure function of committed shards,
+                    # so the cheap, always-correct repair is re-stitch
+                    if repair:
+                        import shutil
+
+                        shutil.rmtree(result_dir, ignore_errors=True)
+                    _issue(
+                        issues, "backfill_result", result_dir, "torn",
+                        _repair_action(repair, "removed"),
+                        "half-committed result (re-stitch)",
+                    )
+            elif result_done:
+                if repair:
+                    _remove_all(done_path)
+                _issue(
+                    issues, "backfill_result", done_path, "corrupt",
+                    _repair_action(repair, "removed"),
+                    "result marker without a result directory",
+                )
+    elapsed = time.perf_counter() - t0
+    get_registry().counter(
+        "tpudas_integrity_audit_runs_total",
+        "integrity audits (startup fsck) executed",
+    ).inc()
+    sub_clean = all(r["clean"] for r in shard_reports.values())
+    repaired = sum(
+        1 for it in issues if it["action"] in _REPAIRED_ACTIONS
+    ) + sum(r["repaired"] for r in shard_reports.values())
+    clean = (
+        error is None
+        and sub_clean
+        and all(it["action"] in _REPAIRED_ACTIONS for it in issues)
+    )
+    report = {
+        "root": root,
+        "repair": bool(repair),
+        "clean": bool(clean),
+        "elapsed_s": round(elapsed, 4),
+        "repaired": repaired,
+        "parked": parked,
+        "issues": issues,
+        "shards": shard_reports,
+        "issues_total": len(issues) + sum(
+            len(r["issues"]) for r in shard_reports.values()
+        ),
+    }
+    if error is not None:
+        report["error"] = error
+    if report["issues_total"]:
+        log_event(
+            "integrity_audit_backfill",
+            root=root,
+            clean=report["clean"],
+            repaired=repaired,
+            parked=len(parked),
         )
     return report

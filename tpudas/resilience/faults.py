@@ -63,6 +63,15 @@ block).  Production code marks its fault sites with
   (tpudas/detect/ledger.py): kill here and the resumed pipeline
   truncates the ledger back to the detect carry and regenerates the
   lost lines byte-identically.
+- ``"backfill.claim"`` — the head of a shard-lease claim/steal write
+  (tpudas/backfill/queue.py): a raise here is a worker dying with its
+  claim half-made — the lease either never lands (shard stays open)
+  or lands and goes stale, and either way another worker reclaims it;
+- ``"backfill.commit"`` — just before a shard's (or the stitch's)
+  atomic staging→final rename (tpudas/backfill/queue.py /
+  stitch.py): a kill here orphans the fully-drained staging directory
+  (swept by ``audit_backfill``) and the shard is re-executed — the
+  exactly-once guarantee is the commit-wins rename, not the worker.
 """
 
 from __future__ import annotations
@@ -386,6 +395,8 @@ FAULT_SITES = (
     "fs.write_enospc",
     "detect.op",
     "detect.ledger_write",
+    "backfill.claim",
+    "backfill.commit",
 )
 
 _ACTIONS = ("raise", "truncate", "delay")

@@ -197,7 +197,8 @@ class ServePool:
     def __init__(self, folder, host="127.0.0.1", port=8000,
                  workers=_DEFAULT_WORKERS, control_port=0, fleet=False,
                  max_inflight=8, cache_tiles=256,
-                 start_timeout=120.0):
+                 start_timeout=120.0, max_restarts=5,
+                 restart_backoff=0.5, supervise=True):
         if not has_reuse_port():
             raise OSError(
                 "SO_REUSEPORT is not available on this platform; "
@@ -221,6 +222,17 @@ class ServePool:
         self.worker_info: dict = {}
         self._control = None
         self._control_thread = None
+        # worker supervision (ISSUE 12): a dead data-plane worker is
+        # respawned (bounded restarts, doubling backoff) instead of
+        # permanently shrinking the pool
+        self.supervise = bool(supervise)
+        self.max_restarts = int(max_restarts)
+        self.restart_backoff = float(restart_backoff)
+        self._restarts: dict = {}  # index -> {count, backoff, next}
+        self._ctx = None
+        self._report_q = None
+        self._monitor_thread = None
+        self._monitor_stop = threading.Event()
 
     def _pick_port(self) -> int:
         # all workers must share ONE concrete port for SO_REUSEPORT
@@ -240,16 +252,10 @@ class ServePool:
 
         # spawn, not fork: the parent may hold jax/threading state a
         # forked HTTP server must never inherit
-        ctx = mp.get_context("spawn")
-        report_q = ctx.Queue()
+        self._ctx = mp.get_context("spawn")
+        self._report_q = self._ctx.Queue()
         for i in range(self.workers):
-            cfg = dict(self._cfg, index=i, port=self.port)
-            proc = ctx.Process(
-                target=_worker_main, args=(cfg, report_q),
-                name=f"tpudas-serve-worker-{i}", daemon=True,
-            )
-            proc.start()
-            self._procs.append(proc)
+            self._procs.append(self._spawn_worker(i))
         deadline = time.time() + self._start_timeout
         while len(self.worker_info) < self.workers:
             if any(p.exitcode not in (None, 0) for p in self._procs):
@@ -259,7 +265,7 @@ class ServePool:
                     "folder readable? port bindable?)"
                 )
             try:
-                info = report_q.get(timeout=0.25)
+                info = self._report_q.get(timeout=0.25)
                 self.worker_info[int(info["worker"])] = info
             except Exception:
                 if time.time() > deadline:
@@ -278,6 +284,13 @@ class ServePool:
             name="tpudas-serve-pool-control", daemon=True,
         )
         self._control_thread.start()
+        if self.supervise:
+            self._monitor_stop.clear()
+            self._monitor_thread = threading.Thread(
+                target=self._monitor,
+                name="tpudas-serve-pool-monitor", daemon=True,
+            )
+            self._monitor_thread.start()
         log_event(
             "serve_pool_started",
             folder=self.folder,
@@ -287,7 +300,83 @@ class ServePool:
         )
         return self
 
+    # -- worker supervision --------------------------------------------
+    def _spawn_worker(self, index: int):
+        cfg = dict(self._cfg, index=index, port=self.port)
+        proc = self._ctx.Process(
+            target=_worker_main, args=(cfg, self._report_q),
+            name=f"tpudas-serve-worker-{index}", daemon=True,
+        )
+        proc.start()
+        return proc
+
+    def _drain_reports(self) -> None:
+        """Pick up (re)spawned workers' port/pid reports so the
+        control plane scrapes the live process, not the corpse."""
+        import queue as _queue
+
+        while True:
+            try:
+                info = self._report_q.get_nowait()
+            except _queue.Empty:
+                return
+            self.worker_info[int(info["worker"])] = info
+
+    def _monitor(self) -> None:
+        """Supervision loop: respawn dead data-plane workers with
+        bounded restarts and doubling backoff — a crashed worker must
+        not permanently shrink the pool.  Restarts are counted
+        (``tpudas_serve_pool_worker_restarts_total``); a worker past
+        ``max_restarts`` stays down and ``/pool/healthz`` reports the
+        pool degraded."""
+        reg = get_registry()
+        while not self._monitor_stop.wait(0.25):
+            self._drain_reports()
+            for i, proc in enumerate(self._procs):
+                if proc is not None and proc.is_alive():
+                    continue
+                rec = self._restarts.setdefault(
+                    i, {
+                        "count": 0,
+                        "backoff": self.restart_backoff,
+                        "next": 0.0,
+                    },
+                )
+                if rec["count"] >= self.max_restarts:
+                    continue
+                now = time.time()
+                if now < rec["next"]:
+                    continue
+                rec["count"] += 1
+                rec["next"] = now + rec["backoff"]
+                rec["backoff"] = min(rec["backoff"] * 2.0, 30.0)
+                reg.counter(
+                    "tpudas_serve_pool_worker_restarts_total",
+                    "dead serve-pool workers respawned by the "
+                    "supervision loop",
+                ).inc()
+                log_event(
+                    "serve_pool_worker_respawned",
+                    worker=i,
+                    restart=rec["count"],
+                )
+                try:
+                    self._procs[i] = self._spawn_worker(i)
+                except Exception as exc:
+                    log_event(
+                        "serve_pool_respawn_failed",
+                        worker=i,
+                        error=f"{type(exc).__name__}: {str(exc)[:200]}",
+                    )
+
+    def restart_counts(self) -> dict:
+        return {i: r["count"] for i, r in sorted(self._restarts.items())}
+
     def stop(self) -> None:
+        if self._monitor_thread is not None:
+            self._monitor_stop.set()
+            self._monitor_thread.join(timeout=10)
+            self._monitor_thread = None
         if self._control is not None:
             self._control.shutdown()
             self._control.server_close()
