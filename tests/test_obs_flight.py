@@ -1,0 +1,640 @@
+"""Cluster observability (ISSUE 13): the crash-surviving flight
+recorder, the round-phase timeline, and the fleet/backfill rollup.
+
+Covers: record/flush/read roundtrip, bounded segment rotation,
+torn-tail recovery (byte-truncate = SIGKILL mid-segment-write →
+readable prefix + audit truncate-repair, clean second audit), KI-kill
+at the ``obs.flight_write`` site, ENOSPC shedding, scoped span
+capture + drop counters, phase-timeline completeness (every processed
+round emits all phases exactly once), the `/trace` + `/slo` + enriched
+`/fleet/healthz` endpoints, and the ``obs_report`` rollup over a
+4-stream fleet and a 2-worker backfill run.
+"""
+
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpudas.obs.collect import (
+    SLOPolicy,
+    backfill_rollup,
+    cluster_snapshot,
+    fleet_rollup,
+    slo_status,
+)
+from tpudas.obs.flight import (
+    FlightRecorder,
+    capture,
+    read_flight,
+    scan_segment,
+    segment_paths,
+)
+from tpudas.obs.phases import PHASES, RoundPhases, phase_seconds_snapshot
+from tpudas.obs.registry import MetricsRegistry, use_registry
+from tpudas.obs.trace import add_span_sink, remove_span_sink, span
+from tpudas.testing import (
+    FaultPlan,
+    FaultSpec,
+    install_fault_plan,
+    make_synthetic_spool,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+T0 = "2023-03-22T00:00:00"
+FS = 50.0
+FILE_SEC = 30.0
+N_CH = 4
+
+
+def _run_stream(src, out, rounds=1, feed=None, **kw):
+    from tpudas.proc.streaming import run_lowpass_realtime
+
+    state = {"fed": 0}
+
+    def fake_sleep(_):
+        if feed is not None and state["fed"] < rounds - 1:
+            state["fed"] += 1
+            feed(state["fed"])
+
+    kwargs = dict(
+        source=src, output_folder=out, start_time=T0,
+        output_sample_interval=1.0, edge_buffer=5.0,
+        process_patch_size=20, poll_interval=0.0,
+        sleep_fn=fake_sleep, max_rounds=rounds + 2,
+        health=True, pyramid=False, detect=False, flight=True,
+    )
+    kwargs.update(kw)
+    return run_lowpass_realtime(**kwargs)
+
+
+def _feed_files(src, first, count):
+    make_synthetic_spool(
+        src, n_files=count, file_duration=FILE_SEC, fs=FS, n_ch=N_CH,
+        noise=0.01,
+        start=np.datetime64(T0)
+        + np.timedelta64(int(first * FILE_SEC * 1e9), "ns"),
+        prefix=f"raw{first:04d}",
+    )
+
+
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_record_flush_read_roundtrip(self, tmp_path):
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            rec = FlightRecorder(tmp_path)
+            rec.record("round", round=1, phases={"poll": 0.1})
+            rec.record("span", name="stream.round", dur_s=0.5, round=1)
+            rec.record("fault", fault_kind="transient", attempt=1)
+            assert rec.flush() == 3
+            rec.close()
+        recs = read_flight(tmp_path)
+        assert [r["kind"] for r in recs] == ["round", "span", "fault"]
+        assert recs[0]["phases"] == {"poll": 0.1}
+        # filters
+        assert len(read_flight(tmp_path, kind="span")) == 1
+        assert read_flight(tmp_path, kind="span", name="stream.round")
+        assert read_flight(tmp_path, limit=2) == recs[-2:]
+        assert reg.value(
+            "tpudas_obs_flight_records_total", kind="span"
+        ) == 1.0
+        assert reg.value("tpudas_obs_flight_bytes_total") > 0
+
+    def test_ring_rotation_is_bounded(self, tmp_path):
+        rec = FlightRecorder(
+            tmp_path, max_segment_bytes=4096, max_segments=3
+        )
+        for i in range(400):
+            rec.record("round", round=i, pad="x" * 64)
+            rec.flush()
+        rec.close()
+        segs = segment_paths(tmp_path)
+        assert 1 < len(segs) <= 3
+        for p in segs:
+            # rotation happens at the flush AFTER crossing the bound,
+            # so a segment may exceed it by at most one record
+            assert os.path.getsize(p) < 4096 + 256
+        # the ring kept the NEWEST records
+        rounds = [r["round"] for r in read_flight(tmp_path, kind="round")]
+        assert rounds[-1] == 399 and rounds[0] > 0
+        assert rounds == sorted(rounds)
+
+    def test_torn_tail_readable_prefix_and_audit_repair(self, tmp_path):
+        from tpudas.integrity.audit import audit
+
+        rec = FlightRecorder(tmp_path)
+        for i in range(10):
+            rec.record("round", round=i)
+        rec.flush()
+        rec.close()
+        seg = segment_paths(tmp_path)[-1]
+        with open(seg, "rb") as fh:
+            data = fh.read()
+        with open(seg, "wb") as fh:
+            fh.write(data[:-15])  # SIGKILL mid-segment-write
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            rounds = [
+                r["round"] for r in read_flight(tmp_path, kind="round")
+            ]
+        assert rounds == list(range(9))  # the verified prefix
+        assert reg.value("tpudas_obs_flight_torn_records_total") == 1.0
+        rep = audit(str(tmp_path), repair=True)
+        assert rep["clean"]
+        assert [(i["artifact"], i["status"], i["action"])
+                for i in rep["issues"]] == [("flight", "torn", "truncated")]
+        rep2 = audit(str(tmp_path), repair=True)
+        assert rep2["clean"] and not rep2["issues"]
+        # the repaired ring resumes appending
+        rec2 = FlightRecorder(tmp_path)
+        rec2.record("round", round=99)
+        rec2.flush()
+        rec2.close()
+        assert read_flight(tmp_path, kind="round")[-1]["round"] == 99
+
+    def test_torn_tail_then_append_rotates_no_record_lost(self, tmp_path):
+        """Resume over an UNAUDITED torn segment: appending onto the
+        torn line would merge it into our first record and silently
+        lose it — the recorder must rotate to a fresh segment."""
+        rec = FlightRecorder(tmp_path)
+        for i in range(5):
+            rec.record("round", round=i)
+        rec.flush()
+        rec.close()
+        seg = segment_paths(tmp_path)[-1]
+        with open(seg, "rb") as fh:
+            data = fh.read()
+        with open(seg, "wb") as fh:
+            fh.write(data[:-9])  # crash mid-write, NO audit yet
+        rec2 = FlightRecorder(tmp_path)
+        rec2.record("round", round=100)
+        rec2.record("round", round=101)
+        rec2.flush()
+        rec2.close()
+        rounds = [r["round"] for r in read_flight(tmp_path, kind="round")]
+        assert rounds == [0, 1, 2, 3, 100, 101]  # only the torn line lost
+        assert len(segment_paths(tmp_path)) == 2  # rotated, not appended
+
+    def test_corrupt_middle_line_skipped_not_fatal(self, tmp_path):
+        rec = FlightRecorder(tmp_path)
+        for i in range(5):
+            rec.record("round", round=i)
+        rec.flush()
+        rec.close()
+        seg = segment_paths(tmp_path)[-1]
+        lines = open(seg).read().splitlines()
+        lines[2] = lines[2].replace('"round":2', '"round":7')  # bit rot
+        with open(seg, "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+        records, good_lines, bad = scan_segment(seg)
+        assert bad == 1
+        assert [r["round"] for r in records] == [0, 1, 3, 4]
+
+    def test_ki_kill_at_flush_site_leaves_verified_prefix(self, tmp_path):
+        from tpudas.integrity.audit import audit
+
+        rec = FlightRecorder(tmp_path)
+        rec.record("round", round=1)
+        rec.flush()
+        rec.record("round", round=2)
+        plan = FaultPlan(
+            FaultSpec("obs.flight_write", exc=KeyboardInterrupt)
+        )
+        with install_fault_plan(plan):
+            with pytest.raises(KeyboardInterrupt):
+                rec.flush()
+        assert plan.fired
+        rounds = [r["round"] for r in read_flight(tmp_path, kind="round")]
+        assert rounds == [1]
+        assert audit(str(tmp_path), repair=True)["clean"]
+
+    def test_enospc_shed_drops_counted_never_raises(self, tmp_path):
+        from tpudas.integrity import resource as _resource
+
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            rec = FlightRecorder(tmp_path)
+            rec.record("round", round=1)
+            _resource.note_pressure("test", None)
+            try:
+                assert rec.flush() == 0  # shed, not written
+            finally:
+                _resource.clear_pressure("test done")
+            assert reg.value(
+                "tpudas_obs_flight_drops_total", reason="shed"
+            ) == 1.0
+            assert reg.value(
+                "tpudas_obs_events_dropped_total", reason="flight_shed"
+            ) == 1.0
+            rec.close()
+        assert read_flight(tmp_path) == []
+
+    def test_write_failure_drops_counted_never_raises(self, tmp_path):
+        # .flight exists as a FILE: every flush write must fail softly
+        open(os.path.join(tmp_path, ".flight"), "w").close()
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            rec = FlightRecorder(tmp_path)
+            rec.record("round", round=1)
+            assert rec.flush() == 0
+            assert reg.value(
+                "tpudas_obs_flight_drops_total", reason="error"
+            ) == 1.0
+
+
+class TestSpanCapture:
+    def test_capture_scopes_spans_to_recorder(self, tmp_path):
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            rec = FlightRecorder(tmp_path)
+            with span("outside.scope"):
+                pass
+            with capture(rec):
+                with span("stream.round", round=3):
+                    with span("stream.increment"):
+                        with span("op.cascade_stream"):  # depth 2: capped
+                            pass
+            with span("outside.after"):
+                pass
+            rec.flush()
+            rec.close()
+        names = [r["name"] for r in read_flight(tmp_path, kind="span")]
+        assert "stream.round" in names and "stream.increment" in names
+        assert "outside.scope" not in names
+        assert "outside.after" not in names
+        assert "op.cascade_stream" not in names  # depth cap (default 2)
+        rec3 = read_flight(tmp_path, kind="span", name="stream.round")[0]
+        assert rec3["round"] == 3 and rec3["dur_s"] >= 0.0
+
+    def test_capture_none_is_noop(self):
+        with capture(None):
+            with span("whatever"):
+                pass
+
+    def test_raising_sink_counted_not_fatal(self):
+        reg = MetricsRegistry()
+
+        def bad_sink(rec):
+            raise RuntimeError("boom")
+
+        add_span_sink(bad_sink)
+        try:
+            with use_registry(reg):
+                with span("sink.victim"):
+                    pass
+        finally:
+            remove_span_sink(bad_sink)
+        assert reg.value(
+            "tpudas_obs_spans_dropped_total", reason="sink_error"
+        ) >= 1.0
+
+    def test_log_event_drops_counted_obs_wide(self):
+        from tpudas.utils.logging import log_event, set_log_handler
+
+        reg = MetricsRegistry()
+
+        def bad_handler(event):
+            raise ValueError("nope")
+
+        set_log_handler(bad_handler)
+        try:
+            with use_registry(reg):
+                log_event("doomed")
+        finally:
+            set_log_handler(None)
+        assert reg.value(
+            "tpudas_obs_events_dropped_total", reason="handler"
+        ) == 1.0
+
+
+# ---------------------------------------------------------------------------
+
+
+class TestPhases:
+    def test_round_phases_accumulate_and_finish(self):
+        reg = MetricsRegistry()
+        ph = RoundPhases()
+        with ph.measure("poll"):
+            pass
+        ph.add("compute", 0.25)
+        ph.add("compute", 0.25)
+        out = ph.finish(reg)
+        assert sorted(out) == sorted(PHASES)
+        assert out["compute"] == 0.5
+        snap = phase_seconds_snapshot(reg)
+        assert set(snap) == set(PHASES)  # every phase observed once
+        for p in PHASES:
+            assert snap[p]["count"] == 1
+
+    def test_realtime_rounds_emit_all_phases_exactly_once(self, tmp_path):
+        src = str(tmp_path / "src")
+        out = str(tmp_path / "out")
+        _feed_files(src, 0, 2)
+        rounds = 3
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            n = _run_stream(
+                src, out, rounds=rounds,
+                feed=lambda r: _feed_files(src, 1 + r, 1),
+            )
+        assert n == rounds
+        # registry: every phase observed exactly once per round
+        snap = phase_seconds_snapshot(reg)
+        assert set(snap) == set(PHASES)
+        for p in PHASES:
+            assert snap[p]["count"] == rounds
+        # flight: each round record carries the full phase dict
+        recs = read_flight(out, kind="round")
+        assert [r["round"] for r in recs] == list(range(1, rounds + 1))
+        for r in recs:
+            assert sorted(r["phases"]) == sorted(PHASES)
+            assert r["phases"]["compute"] > 0.0
+        # a round's spans precede it durably (the drill's replay claim)
+        spans = read_flight(out, kind="span", name="stream.round")
+        assert {s["round"] for s in spans} == {1, 2, 3}
+
+
+# ---------------------------------------------------------------------------
+
+
+class TestSLO:
+    def _ring(self, folder, lags, target_now=None):
+        from tpudas.obs.health import write_health
+
+        rec = FlightRecorder(folder)
+        for i, lag in enumerate(lags):
+            rec.record("round", round=i + 1, head_lag=lag, phases={})
+        rec.flush()
+        rec.close()
+        if target_now is not None:
+            write_health(str(folder), {
+                "rounds": len(lags), "polls": len(lags),
+                "mode": "stateful", "realtime_factor": 10.0,
+                "round_realtime_factor": 10.0,
+                "head_lag_seconds": target_now, "redundant_ratio": 0.0,
+                "carry_resume_count": 0,
+                "last_round_wall_seconds": 0.1,
+                "consecutive_failures": 0, "quarantined_files": 0,
+                "degraded": False, "integrity_fallbacks": 0,
+                "resource_degraded": False, "last_error": None,
+            })
+
+    def test_ok_vs_violating_vs_burn(self, tmp_path):
+        pol = SLOPolicy(head_lag_target_s=100.0, objective=0.9,
+                        window=50)
+        a = tmp_path / "a"
+        a.mkdir()
+        self._ring(a, [10.0] * 20, target_now=10.0)
+        assert slo_status(a, pol)["status"] == "ok"
+        b = tmp_path / "b"
+        b.mkdir()
+        self._ring(b, [10.0] * 20, target_now=500.0)
+        assert slo_status(b, pol)["status"] == "violating"
+        # burn: 20% of rounds over target >> 10% budget, current ok
+        c = tmp_path / "c"
+        c.mkdir()
+        self._ring(c, [10.0] * 16 + [500.0] * 4, target_now=10.0)
+        s = slo_status(c, pol)
+        assert s["status"] == "at_risk"
+        assert s["error_budget_burn"] == pytest.approx(2.0)
+        d = tmp_path / "d"
+        d.mkdir()
+        assert slo_status(d, pol)["status"] == "unknown"
+
+
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fleet_root(tmp_path_factory):
+    """A real 4-stream fleet run (tiny): health + flight per stream."""
+    from tpudas.fleet import FleetEngine, StreamConfig, StreamSpec
+
+    root = str(tmp_path_factory.mktemp("obs_fleet") / "root")
+    src_root = str(tmp_path_factory.mktemp("obs_fleet_src"))
+    config = StreamConfig(
+        kind="lowpass", start_time=T0, output_sample_interval=1.0,
+        edge_buffer=5.0, process_patch_size=20, poll_interval=0.0,
+        health=True, pyramid=False, detect=False,
+    )
+    specs = []
+    for i in range(4):
+        src = os.path.join(src_root, f"s{i:02d}")
+        _feed_files(src, 0, 2)
+        specs.append(StreamSpec(
+            stream_id=f"s{i:02d}", source=src, config=config,
+        ))
+    summary = FleetEngine(
+        root, specs, max_rounds=3, sleep_fn=lambda _s: None,
+    ).run()
+    assert summary["rounds_total"] >= 4
+    return root
+
+
+@pytest.fixture(scope="module")
+def backfill_root(tmp_path_factory):
+    """A tiny 2-worker backfill run over a 2-shard plan."""
+    from tpudas.backfill import plan_backfill, run_worker
+
+    src = str(tmp_path_factory.mktemp("obs_bf") / "src")
+    root = str(tmp_path_factory.mktemp("obs_bf") / "root")
+    make_synthetic_spool(
+        src, n_files=4, file_duration=FILE_SEC, fs=FS, n_ch=N_CH,
+        noise=0.01, start=np.datetime64(T0),
+    )
+    t_end = np.datetime64(T0) + np.timedelta64(
+        int(4 * FILE_SEC * 1e9), "ns"
+    )
+    plan_backfill(
+        root, src, T0, t_end, shard_seconds=60.0,
+        output_sample_interval=1.0, edge_buffer=5.0,
+        process_patch_size=20, pyramid=False, detect=False,
+    )
+    tallies = [
+        run_worker(root, worker=f"w{i}", settle=0.0, max_wall=300)
+        for i in range(2)
+    ]
+    assert any(t["stitched"] for t in tallies)
+    return root
+
+
+class TestRollup:
+    def test_fleet_rollup_over_4_stream_run(self, fleet_root):
+        roll = fleet_rollup(fleet_root)
+        assert sorted(roll["streams"]) == [f"s{i:02d}" for i in range(4)]
+        assert roll["status"] == "ok"
+        for entry in roll["streams"].values():
+            assert entry["status"] == "ok"
+            assert entry["rounds"] >= 1
+            assert entry["realtime_factor"] > 0
+            assert entry["slo"]["status"] == "ok"
+            assert entry["flight"]["last_round"] >= 1
+            assert sorted(entry["flight"]["phases"]) == sorted(PHASES)
+
+    def test_backfill_rollup_after_2_worker_run(self, backfill_root):
+        roll = backfill_rollup(backfill_root)
+        assert roll["status"] == "done"
+        assert roll["result_done"]
+        assert roll["shards"]["done"] == roll["shards_total"] == 2
+        assert roll["done_fraction"] == 1.0
+        assert roll["parked"] == []
+
+    def test_backfill_rollup_unreadable_root(self, tmp_path):
+        roll = backfill_rollup(str(tmp_path / "nope"))
+        assert roll["status"] == "unreadable"
+
+    def test_cluster_snapshot_combines_planes(self, fleet_root,
+                                              backfill_root):
+        snap = cluster_snapshot(
+            fleet_root=fleet_root, backfill_root=backfill_root,
+        )
+        assert snap["status"] == "ok"
+        assert len(snap["fleet"]["streams"]) == 4
+        assert snap["backfill"]["status"] == "done"
+        # pool: unreachable is a status, not an exception
+        snap2 = cluster_snapshot(
+            fleet_root=fleet_root,
+            pool_url="http://127.0.0.1:1/nope",
+        )
+        assert snap2["pool"]["status"] == "unreachable"
+        assert snap2["status"] != "ok"
+
+    def test_obs_report_cli(self, fleet_root, backfill_root, tmp_path,
+                            capsys):
+        import obs_report
+
+        out = str(tmp_path / "report.json")
+        rc = obs_report.main([
+            "--fleet", fleet_root, "--backfill", backfill_root,
+            "--out", out, "--strict",
+        ])
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert "cluster status: ok" in text
+        assert "s00" in text and "backfill: done" in text
+        with open(out) as fh:
+            snap = json.load(fh)
+        assert len(snap["fleet"]["streams"]) == 4
+
+    def test_obs_report_cli_json_single_stream(self, fleet_root,
+                                               capsys):
+        import obs_report
+
+        stream = os.path.join(fleet_root, "s00")
+        # --strict must pass on a healthy single stream: the overall
+        # status is recomputed from the merged entry, not left at the
+        # empty snapshot's "unknown" placeholder
+        rc = obs_report.main(["--stream", stream, "--json", "--strict"])
+        assert rc == 0
+        snap = json.loads(capsys.readouterr().out)
+        assert "s00" in snap["fleet"]["streams"]
+        assert snap["status"] == "ok"
+        assert snap["fleet"]["counts"] == {"ok": 1}
+        assert snap["fleet"]["slo_counts"] == {"ok": 1}
+
+
+# ---------------------------------------------------------------------------
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read().decode())
+
+
+class TestServeEndpoints:
+    def test_trace_slo_and_fleet_healthz(self, fleet_root):
+        from tpudas.serve.http import DASServer
+
+        with DASServer.for_fleet(fleet_root) as server:
+            base = server.base_url
+            # /trace over one stream's flight ring
+            tr = _get_json(f"{base}/s/s00/trace?limit=50")
+            assert tr["source"] == "flight" and tr["count"] >= 1
+            assert all(r["kind"] == "span" for r in tr["records"])
+            rounds = _get_json(f"{base}/s/s00/trace?kind=round")
+            assert rounds["records"][-1]["phases"]
+            named = _get_json(
+                f"{base}/s/s00/trace?name=stream.round&limit=5"
+            )
+            assert all(
+                r["name"] == "stream.round" for r in named["records"]
+            )
+            # /slo: per-stream and aggregate
+            slo = _get_json(f"{base}/s/s01/slo")
+            assert slo["status"] == "ok"
+            agg = _get_json(f"{base}/slo?target=150")
+            assert set(agg["streams"]) == {
+                f"s{i:02d}" for i in range(4)
+            }
+            # /fleet/healthz now carries slo + freshness per stream
+            fh = _get_json(f"{base}/fleet/healthz")
+            assert fh["status"] == "ok"
+            for entry in fh["streams"].values():
+                assert entry["slo"]["status"] == "ok"
+                assert entry["realtime_factor"] > 0
+                assert "head_lag_seconds" in entry
+            assert fh["slo_counts"] == {"ok": 4}
+            # unknown stream still 404s
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get_json(f"{base}/s/zz/trace")
+            assert err.value.code == 404
+
+    def test_trace_ring_fallback_without_flight(self, tmp_path):
+        from tpudas.obs.trace import clear_spans
+        from tpudas.serve.http import DASServer
+
+        folder = str(tmp_path / "plain")
+        os.makedirs(folder)
+        clear_spans()
+        with span("ring.only", tag=1):
+            pass
+        with DASServer(folder) as server:
+            tr = _get_json(f"{server.base_url}/trace?name=ring.only")
+            assert tr["source"] == "ring"
+            assert tr["count"] == 1
+
+    def test_fleet_park_event_timestamps(self, tmp_path):
+        """A parked stream's health carries the park event with
+        wall-clock timestamps, and the rollup surfaces it."""
+        from tpudas.fleet import FleetEngine, StreamConfig, StreamSpec
+
+        root = str(tmp_path / "root")
+        src = str(tmp_path / "src")
+        _feed_files(src, 0, 2)
+        good = StreamConfig(
+            kind="lowpass", start_time=T0, output_sample_interval=1.0,
+            edge_buffer=5.0, process_patch_size=20, poll_interval=0.0,
+            health=True, pyramid=False, detect=False,
+        )
+        # "bad" listed first: the deficit round-robin serves spec
+        # order on the all-equal first pass, so the site's FIRST
+        # round.body hit (the injected fatal) lands on it
+        specs = [
+            StreamSpec(stream_id="bad", source=src, config=good),
+            StreamSpec(stream_id="good", source=src, config=good),
+        ]
+        plan = FaultPlan(FaultSpec(
+            "round.body", exc=ValueError("fatal config"), at=1,
+        ))
+        import time as _t
+
+        t_before = _t.time()
+        with install_fault_plan(plan):
+            summary = FleetEngine(
+                root, specs, max_rounds=2, sleep_fn=lambda _s: None,
+            ).run()
+        assert summary["streams"]["bad"]["status"] == "parked"
+        assert summary["streams"]["bad"]["parked_at"] >= t_before
+        roll = fleet_rollup(root)
+        ev = roll["streams"]["bad"].get("fleet")
+        assert ev is not None and ev["event"] == "parked"
+        assert ev["parked_at"] >= t_before and ev["unparked_at"] is None

@@ -129,6 +129,19 @@ REQUIRED_METRICS = (
     "tpudas_backfill_stitch_rows_total",
     "tpudas_serve_pool_worker_restarts_total",
     "tpudas_fleet_unparked_total",
+    # cluster observability (PR 13): the round-phase timeline, the
+    # crash-surviving flight recorder, and the obs-wide drop counters
+    # — tools/obs_bench.py, tools/obs_report.py, tools/crash_drill.py
+    # (the flight leg), and the OBSERVABILITY.md runbook read these
+    "tpudas_stream_round_phase_seconds",
+    "tpudas_obs_flight_records_total",
+    "tpudas_obs_flight_bytes_total",
+    "tpudas_obs_flight_drops_total",
+    "tpudas_obs_flight_segments",
+    "tpudas_obs_flight_rotations_total",
+    "tpudas_obs_flight_torn_records_total",
+    "tpudas_obs_spans_dropped_total",
+    "tpudas_obs_events_dropped_total",
 )
 REQUIRED_SPANS = (
     "serve.request",
@@ -151,6 +164,9 @@ REQUIRED_SPANS = (
     "backfill.shard",
     "backfill.stitch",
     "backfill.audit",
+    "obs.rollup",
+    "serve.trace",
+    "serve.slo",
 )
 
 

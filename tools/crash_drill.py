@@ -18,10 +18,15 @@ One drill (per engine):
    spawn the driver in a fresh subprocess (pyramid + health +
    stateful carry + detect operators on), SIGKILL it
    ``uniform(0.02, 0.95 * calib)`` seconds after it becomes ready;
-3. run one final uninterrupted cycle to drain, then assert
+3. right after the kill cycles — BEFORE the drain — assert the
+   on-disk flight recorder (ISSUE 13, ``tpudas.obs.flight``) replays
+   the final committed round: its ``round`` record carries all eight
+   phases and is preceded by that round's spans (``stream.round``
+   included) in the surviving ring;
+4. run one final uninterrupted cycle to drain, then assert
    ``tpudas.integrity.audit`` reports **clean** (each worker already
    audited + repaired at startup — this run must find nothing left);
-4. replay the SAME epoch schedule uninterrupted into a fresh control
+5. replay the SAME epoch schedule uninterrupted into a fresh control
    folder and assert:
 
    - the merged OUTPUT CONTENT (time grid + float32 samples) is
@@ -383,6 +388,39 @@ def _detect_state(folder: str) -> dict:
     return out
 
 
+def _flight_replay_check(folder: str) -> dict:
+    """The ISSUE 13 flight-recorder leg: called right after the kill
+    cycles (BEFORE the drain), so it asserts what the on-disk ring
+    holds at the moment an operator would arrive at a SIGKILLed box —
+    the final committed round's record (all phases present) preceded
+    by that round's spans.  The recorder flushes a round's spans and
+    its ``round`` record in one write, so any surviving round record
+    implies its spans survived too; this verifies that end to end."""
+    from tpudas.obs.flight import read_flight
+    from tpudas.obs.phases import PHASES
+
+    recs = read_flight(folder)
+    rounds = [r for r in recs if r.get("kind") == "round"]
+    if not rounds:
+        return {"ok": False, "rounds": 0,
+                "reason": "no committed round records in the ring"}
+    last = rounds[-1]
+    spans = [
+        r for r in recs
+        if r.get("kind") == "span" and r.get("round") == last["round"]
+    ]
+    has_round_span = any(r.get("name") == "stream.round" for r in spans)
+    phases_complete = sorted(last.get("phases", {})) == sorted(PHASES)
+    return {
+        "ok": bool(has_round_span and phases_complete),
+        "rounds": len(rounds),
+        "last_round": last.get("round"),
+        "last_round_spans": len(spans),
+        "phases_complete": phases_complete,
+        "records_total": len(recs),
+    }
+
+
 def run_drill(
     engine: str = "cascade",
     cycles: int = 25,
@@ -444,6 +482,10 @@ def run_drill(
                 # later draws keep landing inside the work window
                 est = max(0.5 * est + 0.5 * r["wall"], 0.2)
             cycle_log.append({"kill_after": round(kill_after, 3), **r})
+        # flight-recorder replay (ISSUE 13): inspected NOW, after the
+        # SIGKILL cycles and before the drain — the on-disk ring must
+        # already replay the final committed round's spans + phases
+        flight = _flight_replay_check(out)
         # drain: the resumed run finishes everything the kills left
         _run_cycle(src, out, engine, None, log_fh, mesh=mesh)
         # the drained folder must audit clean (each worker already
@@ -482,11 +524,12 @@ def run_drill(
             "pyramid_files": len(pyr_out),
             "detect_match": bool(detect_match),
             "detect_events": int(detect_events),
+            "flight": flight,
             "cycle_log": cycle_log,
             "workdir": workdir,
             "ok": bool(
                 report["clean"] and outputs_match and pyramid_match
-                and detect_match
+                and detect_match and flight["ok"]
             ),
         }
     finally:
@@ -686,7 +729,9 @@ def main(argv=None) -> int:
             f"outputs_match={rep['outputs_match']} "
             f"pyramid_match={rep['pyramid_match']} "
             f"detect_match={rep['detect_match']} "
-            f"(events={rep['detect_events']})"
+            f"flight_replay={rep['flight']['ok']} "
+            f"(events={rep['detect_events']}, "
+            f"flight_rounds={rep['flight']['rounds']})"
         )
     payload = {"cycles": args.cycles, "seed": args.seed,
                "mesh": args.mesh, "streams": args.streams,

@@ -1,0 +1,268 @@
+"""Observability bench (ISSUE 13): flight-recorder + phase-timeline
+overhead, and cluster-rollup wall time.
+
+Two acceptance numbers for BENCH_pr13.json:
+
+1. **Instrumentation overhead < 1% of the steady round body.**  A
+   real realtime drive (flight recorder + phase timeline + health on)
+   establishes the steady-state round-body floor and the per-round
+   instrumentation volume (spans captured into the flight ring per
+   round); a deterministic bundle replay then measures exactly the
+   added work — 8 phase measures + the histogram finish, the span /
+   round records (2x-overcounted volume), and the per-round flush —
+   the same methodology as BENCH_pr02's obs overhead (whole-drive A/B
+   cannot resolve a sub-percent effect under shared-CPU scheduler
+   noise; the replay measures the added instructions).
+2. **Rollup wall time over an 8-stream fleet.**  Synthesizes a fleet
+   root (per-stream `health.json` + a flight ring of round records)
+   and times `tpudas.obs.collect.cluster_snapshot` — the cost of one
+   `tools/obs_report.py` / `GET /slo` evaluation.
+
+    JAX_PLATFORMS=cpu python tools/obs_bench.py [--out BENCH_pr13.json]
+        [--rounds N] [--streams 8] [--flight-rounds 120]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+FS = 100.0
+FILE_SEC = 30.0
+N_CH = 8
+DT_OUT = 1.0
+EDGE_SEC = 8.0
+PATCH_OUT = 40
+T0 = "2023-03-22T00:00:00"
+
+
+def _drive_instrumented(td, rounds):
+    """One realtime drive with the full ISSUE-13 instrumentation on.
+    Returns (per-round body walls, spans-per-round, flight stats)."""
+    from tpudas.obs.registry import MetricsRegistry, use_registry
+    from tpudas.proc.streaming import run_lowpass_realtime
+    from tpudas.testing import make_synthetic_spool
+
+    src = os.path.join(td, "src")
+    out = os.path.join(td, "out")
+    n_init = 2
+    make_synthetic_spool(
+        src, n_files=n_init, file_duration=FILE_SEC, fs=FS, n_ch=N_CH,
+        noise=0.01,
+    )
+    state = {"fed": 0}
+
+    def feed(_):
+        if state["fed"] < rounds - 1:
+            state["fed"] += 1
+            make_synthetic_spool(
+                src, n_files=1, file_duration=FILE_SEC, fs=FS,
+                n_ch=N_CH, noise=0.01,
+                start=np.datetime64(T0) + np.timedelta64(
+                    int((n_init + state["fed"] - 1) * FILE_SEC * 1e9),
+                    "ns",
+                ),
+                prefix=f"raw{state['fed']}",
+            )
+
+    reg = MetricsRegistry()
+    bodies = []
+
+    def on_round(rnd, _lfp):
+        hist = reg.get("tpudas_stream_round_body_seconds")
+        if hist is not None:
+            snap = hist.snapshot()
+            bodies.append((snap["count"], snap["sum"]))
+
+    with use_registry(reg):
+        run_lowpass_realtime(
+            source=src, output_folder=out, start_time=T0,
+            output_sample_interval=DT_OUT, edge_buffer=EDGE_SEC,
+            process_patch_size=PATCH_OUT, poll_interval=0.0,
+            sleep_fn=feed, max_rounds=rounds + 2, on_round=on_round,
+            health=True, pyramid=True, detect=False, flight=True,
+        )
+    walls = [
+        bodies[i][1] - bodies[i - 1][1] for i in range(1, len(bodies))
+    ]
+    n_rounds = bodies[-1][0] if bodies else 0
+    spans = reg.value("tpudas_obs_flight_records_total", kind="span")
+    flight = {
+        "records_span": int(spans),
+        "records_round": int(
+            reg.value("tpudas_obs_flight_records_total", kind="round")
+        ),
+        "bytes": int(reg.value("tpudas_obs_flight_bytes_total")),
+        "drops": 0,
+    }
+    spans_per_round = int(np.ceil(spans / max(n_rounds, 1)))
+    return walls, n_rounds, spans_per_round, flight
+
+
+def _replay_cost(td, spans_per_round, reps=300):
+    """Deterministic per-round cost of the ISSUE-13 instrumentation:
+    the phase timeline (8 measures + histogram finish) plus the
+    flight records (2x-overcounted span volume + the round record)
+    and the per-round flush."""
+    from tpudas.obs.flight import FlightRecorder
+    from tpudas.obs.phases import PHASES, RoundPhases
+    from tpudas.obs.registry import MetricsRegistry, use_registry
+
+    folder = os.path.join(td, "replay")
+    os.makedirs(folder, exist_ok=True)
+    rec = FlightRecorder(folder)
+    reg = MetricsRegistry()
+    n_spans = 2 * max(spans_per_round, 1)
+    with use_registry(reg):
+        t0 = time.perf_counter()
+        for i in range(reps):
+            ph = RoundPhases()
+            for phase in PHASES:
+                with ph.measure(phase):
+                    pass
+            for j in range(n_spans):
+                rec.record(
+                    "span", stream="bench", name="op.cascade_stream",
+                    depth=2, dur_s=0.01, rows=3200, round=i,
+                )
+            rec.record(
+                "round", stream="bench", round=i, mode="stateful",
+                data_seconds=30.0, realtime_factor=100.0,
+                head_lag=10.0, phases=ph.finish(reg),
+            )
+            rec.flush()
+        per_round = (time.perf_counter() - t0) / reps
+    return per_round, n_spans
+
+
+def _synthesize_fleet(root, streams, flight_rounds):
+    """A fleet root of `streams` synthetic members, each with a valid
+    health.json and a flight ring of `flight_rounds` round records —
+    what the rollup actually reads."""
+    from tpudas.obs.flight import FlightRecorder
+    from tpudas.obs.health import write_health
+
+    for i in range(streams):
+        folder = os.path.join(root, f"s{i:02d}")
+        os.makedirs(folder, exist_ok=True)
+        write_health(folder, {
+            "rounds": flight_rounds, "polls": flight_rounds,
+            "mode": "stateful", "realtime_factor": 50.0,
+            "round_realtime_factor": 50.0,
+            "head_lag_seconds": 20.0 + i, "redundant_ratio": 0.0,
+            "carry_resume_count": 1, "last_round_wall_seconds": 0.05,
+            "consecutive_failures": 0, "quarantined_files": 0,
+            "degraded": False, "integrity_fallbacks": 0,
+            "resource_degraded": False, "last_error": None,
+        })
+        rec = FlightRecorder(folder)
+        for r in range(flight_rounds):
+            rec.record(
+                "round", stream=f"s{i:02d}", round=r + 1,
+                mode="stateful", data_seconds=30.0,
+                realtime_factor=50.0,
+                head_lag=20.0 + (5.0 if r % 37 == 0 else 0.0),
+                phases={p: 0.01 for p in (
+                    "poll", "read_decode", "place", "compute",
+                    "commit", "pyramid", "detect", "health",
+                )},
+            )
+            if r % 4 == 3:
+                rec.flush()
+        rec.flush()
+
+
+def run(out_path, rounds=6, streams=8, flight_rounds=120):
+    import tempfile
+
+    from tpudas.obs.collect import cluster_snapshot
+
+    t_bench0 = time.perf_counter()
+    with tempfile.TemporaryDirectory() as td:
+        walls, n_rounds, spans_per_round, flight = _drive_instrumented(
+            td, rounds
+        )
+        steady = walls[1:] or walls
+        floor = min(steady) if steady else 0.0
+        per_round, n_spans = _replay_cost(td, spans_per_round)
+        overhead_pct = (
+            round(100.0 * per_round / floor, 3) if floor else None
+        )
+
+        fleet_root = os.path.join(td, "fleet")
+        _synthesize_fleet(fleet_root, streams, flight_rounds)
+        rollup_walls = []
+        snap = None
+        for _ in range(5):
+            t0 = time.perf_counter()
+            snap = cluster_snapshot(fleet_root=fleet_root)
+            rollup_walls.append(time.perf_counter() - t0)
+        assert snap is not None and len(snap["fleet"]["streams"]) == streams
+
+    report = {
+        "metric": "obs_flight_phase_overhead",
+        "config": {
+            "fs": FS, "n_ch": N_CH, "file_sec": FILE_SEC,
+            "rounds": rounds, "streams": streams,
+            "flight_rounds_per_stream": flight_rounds,
+        },
+        "drive": {
+            "rounds": int(n_rounds),
+            "steady_round_body_s": [round(w, 5) for w in steady],
+            "steady_round_body_floor_s": round(floor, 5),
+            "spans_per_round": spans_per_round,
+            "flight": flight,
+        },
+        "instrumentation": {
+            "replayed_spans_per_round": n_spans,
+            "per_round_cost_s": round(per_round, 6),
+            # the acceptance number: flight + phase instrumentation as
+            # a fraction of the steady round body (2x-overcounted span
+            # volume; replay includes the per-round flush write)
+            "overhead_pct": overhead_pct,
+            "acceptance": "overhead_pct < 1.0",
+        },
+        "rollup": {
+            "streams": streams,
+            "wall_s": [round(w, 5) for w in rollup_walls],
+            "wall_min_s": round(min(rollup_walls), 5),
+            "wall_mean_s": round(
+                sum(rollup_walls) / len(rollup_walls), 5
+            ),
+            "status": snap["status"],
+        },
+        "bench_wall_s": round(time.perf_counter() - t_bench0, 2),
+        "ok": bool(overhead_pct is not None and overhead_pct < 1.0),
+    }
+    with open(out_path, "w") as fh:
+        json.dump(report, fh, indent=1)
+        fh.write("\n")
+    print(json.dumps(report))
+    return report
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default=os.path.join(REPO, "BENCH_pr13.json"))
+    ap.add_argument("--rounds", type=int, default=6)
+    ap.add_argument("--streams", type=int, default=8)
+    ap.add_argument("--flight-rounds", type=int, default=120)
+    args = ap.parse_args()
+    report = run(
+        args.out, rounds=args.rounds, streams=args.streams,
+        flight_rounds=args.flight_rounds,
+    )
+    sys.exit(0 if report["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -163,7 +163,10 @@ def _drive(src, out, rounds, files_per_round, stateful, feed,
         if span_hist is not None
         else 0
     )
+    from tpudas.obs.phases import phase_seconds_snapshot
+
     return {
+        "phase_seconds": phase_seconds_snapshot(reg),
         "rounds": n_rounds,
         "mode": per_round[-1]["mode"] if per_round else None,
         "obs_span_count": span_count,
@@ -508,6 +511,10 @@ def run(out_path, rounds=4, files_per_round=2):
         "head_lag_s": {m: results[m]["head_lag_s"] for m in results},
         "outputs_match_rel_err": round(rel, 8),
         "outputs_match": rel < 1e-4,
+        # the round-phase timeline (ISSUE 13): where the stateful
+        # mode's wall time goes, per phase — the baseline ROADMAP
+        # item 1 (async pipelined ingest) must beat
+        "phase_breakdown": results["stateful"]["phase_seconds"],
         "headline_source": "tpudas.obs.registry",
         "obs_overhead": obs_overhead,
         "modes": results,
@@ -516,6 +523,17 @@ def run(out_path, rounds=4, files_per_round=2):
     with open(out_path, "w") as fh:
         json.dump(report, fh, indent=1)
         fh.write("\n")
+    # human-readable phase table (the JSON keeps the full numbers)
+    phases = report["phase_breakdown"]
+    if phases:
+        total = sum(p["sum"] for p in phases.values()) or 1.0
+        print("round-phase breakdown (stateful mode):")
+        print(f"  {'phase':<12}{'mean_s':>10}{'share':>8}")
+        for name, p in phases.items():
+            print(
+                f"  {name:<12}{p['mean']:>10.4f}"
+                f"{100.0 * p['sum'] / total:>7.1f}%"
+            )
     print(json.dumps(report))
     return report
 

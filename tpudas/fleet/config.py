@@ -57,6 +57,7 @@ COMMON_FIELDS = (
     "detect",
     "detect_operators",
     "poll_jitter",
+    "flight",
 )
 
 # knobs only the low-pass (stateful/joint) driver understands
@@ -132,6 +133,7 @@ class StreamConfig:
     detect: object = None
     detect_operators: object = None
     poll_jitter: object = None  # fraction; None -> TPUDAS_POLL_JITTER/0
+    flight: object = None  # on-disk flight recorder; None -> TPUDAS_FLIGHT/1
     # -- lowpass only ---------------------------------------------------
     start_time: object = None
     output_sample_interval: object = None

@@ -45,7 +45,9 @@ import numpy as np
 from tpudas.core.timeutils import to_datetime64, to_timedelta64
 from tpudas.fleet.config import StreamSpec
 from tpudas.io.spool import spool as make_spool
+from tpudas.obs.flight import capture as flight_capture
 from tpudas.obs.health import write_health, write_prom
+from tpudas.obs.phases import RoundPhases
 from tpudas.obs.registry import get_registry
 from tpudas.obs.trace import span
 from tpudas.proc.lfproc import LFProc
@@ -312,6 +314,19 @@ def _append_pyramid(output_folder, rnd, emitted, state) -> None:
         log_event("pyramid_append", round=rnd, rows=int(appended))
 
 
+def _place_span_seconds(reg) -> float:
+    """Cumulative ``parallel.place`` span seconds from the span
+    histogram — the delta around one processing call is that round's
+    H2D placement time (0 unsharded / under a no-op registry)."""
+    hist = reg.get("tpudas_span_seconds") if hasattr(reg, "get") else None
+    if hist is None or not hasattr(hist, "snapshot"):
+        return 0.0
+    try:
+        return float(hist.snapshot(name="parallel.place")["sum"])
+    except Exception:
+        return 0.0
+
+
 def _head_lag_seconds(t2, lfp, carry) -> float | None:
     """Stream-seconds between the fiber head (newest indexed input,
     ``t2``) and the newest emitted output — the operator's "how far
@@ -421,6 +436,33 @@ class StreamRunner:
         self.time_range = None  # (lo, hi) numpy datetime64 or None
         self.ingest_limit_sec = None  # max data-seconds per round
         self._more_to_drain = False  # last round hit the ingest limit
+        # observability (ISSUE 13): the crash-surviving flight recorder
+        # (subclasses call _init_flight once the folder exists) and the
+        # in-flight round's phase timeline
+        self.flight = None
+        self._round_phases = None
+
+    def _init_flight(self, cfg) -> None:
+        """Open the on-disk flight recorder beside the carry
+        (``flight=`` / ``TPUDAS_FLIGHT``, default on — the recorder
+        exists precisely for the SIGKILL the in-memory ring cannot
+        survive).  Called after the startup audit so a repaired ring
+        is resumed, not raced."""
+        flight = cfg.flight
+        if flight is None:
+            flight = os.environ.get("TPUDAS_FLIGHT", "1") == "1"
+        if flight:
+            from tpudas.obs.flight import FlightRecorder
+
+            self.flight = FlightRecorder(self.output_folder)
+
+    def _flight_record(self, kind: str, **fields) -> None:
+        if self.flight is not None:
+            self.flight.record(kind, stream=self.stream_id, **fields)
+
+    def _flight_flush(self) -> None:
+        if self.flight is not None:
+            self.flight.flush()
 
     def poll_delay(self) -> float:
         """The advisory wait before the next poll: the clamped
@@ -505,6 +547,7 @@ class LowpassStreamRunner(StreamRunner):
         # pyramid) is loaded: stale tmp sweep, checksum verification,
         # .prev promotion, pyramid rebuild — see tpudas.integrity.audit
         _startup_audit(self.output_folder)
+        self._init_flight(cfg)
         from tpudas.integrity import resource as _resource
 
         if _resource.is_degraded():
@@ -574,49 +617,63 @@ class LowpassStreamRunner(StreamRunner):
         ).inc()
         from tpudas.integrity import resource as _resource
 
+        # the round's phase timeline (ISSUE 13): every processed round
+        # emits all phases exactly once; spans emitted on this thread
+        # during the step land in this stream's flight recorder
+        ph = self._round_phases = RoundPhases()
         try:
-            fault_point("round.body", poll=self.polls)
-            # quarantine exclusion + index update + scan-failure
-            # strikes + slow-schedule probe bookkeeping
-            sp = self.boundary.begin_round(
-                make_spool(self.source), self.source
-            )
-            sub = (
-                sp.select(distance=self.distance)
-                if self.distance is not None
-                else sp
-            )
-            if self.time_range is not None:
-                sub = sub.select(time=self.time_range)
-            n_now = len(sub)
-            if (
-                self.len_last is not None
-                and n_now == self.len_last
-                and self.boundary.consecutive == 0
-                and not self._more_to_drain
-            ):
-                print("No new data was detected. Real-time processing ended successfully.")
-                return StepResult("terminate")
-            status = "empty"
-            if n_now > 0:
-                status = "processed"
-                self._process_round(sub, reg)
-            else:
-                self.boundary.on_success()
-            if _resource.is_degraded():
-                # disk-full recovery probe: one tiny write — the
-                # moment it succeeds, shed writers resume and the
-                # pyramid backfills from the output files
-                _resource.probe_recovery(self.output_folder)
-            # every poll (including an empty first one) sets the
-            # growth baseline: the next no-growth poll terminates
-            # (reference semantics — the loop ends when the spool
-            # stops growing, low_pass_dascore_edge.ipynb:205-207)
-            self.len_last = n_now
+            with flight_capture(self.flight):
+                fault_point("round.body", poll=self.polls)
+                # quarantine exclusion + index update + scan-failure
+                # strikes + slow-schedule probe bookkeeping
+                with ph.measure("poll"):
+                    sp = self.boundary.begin_round(
+                        make_spool(self.source), self.source
+                    )
+                    sub = (
+                        sp.select(distance=self.distance)
+                        if self.distance is not None
+                        else sp
+                    )
+                    if self.time_range is not None:
+                        sub = sub.select(time=self.time_range)
+                    n_now = len(sub)
+                if (
+                    self.len_last is not None
+                    and n_now == self.len_last
+                    and self.boundary.consecutive == 0
+                    and not self._more_to_drain
+                ):
+                    print("No new data was detected. Real-time processing ended successfully.")
+                    return StepResult("terminate")
+                status = "empty"
+                if n_now > 0:
+                    status = "processed"
+                    self._process_round(sub, reg)
+                else:
+                    self.boundary.on_success()
+                if _resource.is_degraded():
+                    # disk-full recovery probe: one tiny write — the
+                    # moment it succeeds, shed writers resume and the
+                    # pyramid backfills from the output files
+                    _resource.probe_recovery(self.output_folder)
+                # every poll (including an empty first one) sets the
+                # growth baseline: the next no-growth poll terminates
+                # (reference semantics — the loop ends when the spool
+                # stops growing, low_pass_dascore_edge.ipynb:205-207)
+                self.len_last = n_now
         except Exception as exc:
             decision = self.boundary.on_failure(exc)
             if decision.propagate:
                 raise
+            # the retry survives the crash the flight ring exists for:
+            # record it durably before the backoff sleep
+            self._flight_record(
+                "fault", poll=self.polls, fault_kind=decision.kind,
+                attempt=self.boundary.consecutive,
+                error=f"{type(exc).__name__}: {str(exc)[:200]}",
+            )
+            self._flight_flush()
             # crash-equivalent retry: drop the in-memory carry and
             # re-resolve it from disk on the next attempt — the
             # resume path reconciles any partial outputs exactly as
@@ -644,7 +701,11 @@ class LowpassStreamRunner(StreamRunner):
     def _process_round(self, sub, reg) -> None:
         from tpudas.integrity import resource as _resource
 
+        ph = self._round_phases
+        if ph is None:  # direct callers outside step() still time
+            ph = self._round_phases = RoundPhases()
         t_body = _time.perf_counter()
+        t_prep0 = t_body  # host prep until the processing call
         joint_extra = {}
         if self.rolling_output_folder is not None:
             from tpudas.proc.joint import JointProc
@@ -704,6 +765,11 @@ class LowpassStreamRunner(StreamRunner):
             if cap2 < t2:
                 t2 = cap2
                 self._more_to_drain = True
+        # host prep so far (LFProc build, carry resolution, index
+        # metadata) charges the read_decode phase; the in-call window
+        # read / decode wait is mirrored out of lfp.timings below
+        ph.add("read_decode", _time.perf_counter() - t_prep0)
+        place0 = _place_span_seconds(reg)
         redundant = 0.0
         if self.stateful:
             # carried state: only NEW samples are read/filtered
@@ -713,10 +779,12 @@ class LowpassStreamRunner(StreamRunner):
                 else self.start_time
             )
             data_sec, ch_samples = _covered_workload(contents, t1, t2)
+            t_proc0 = _time.perf_counter()
             with span(
                 "stream.round", mode="stateful", round=rnd
             ), self.counters.measure(int(ch_samples), data_sec):
                 lfp.process_stream_increment(self.carry, t2)
+            proc_wall = _time.perf_counter() - t_proc0
             from tpudas.proc.stream import save_carry
 
             # saved AFTER the outputs: the carry is never ahead of the
@@ -726,7 +794,8 @@ class LowpassStreamRunner(StreamRunner):
             # the tail byte-identically.
             self.carry_unsaved += 1
             if self.carry_unsaved >= self.carry_save_every:
-                save_carry(self.carry, self.output_folder)
+                with ph.measure("commit"):
+                    save_carry(self.carry, self.output_folder)
                 self.carry_unsaved = 0
         else:
             resumed_stateful = False
@@ -776,10 +845,27 @@ class LowpassStreamRunner(StreamRunner):
                     contents, t1, min(self.prev_t2, t2)
                 )
                 self.counters.add_redundant(int(redundant))
+            t_proc0 = _time.perf_counter()
             with span(
                 "stream.round", mode="rewind", round=rnd
             ), self.counters.measure(int(ch_samples), data_sec):
                 lfp.process_time_range(t1, t2)
+            proc_wall = _time.perf_counter() - t_proc0
+        # phase attribution of the processing call: the fresh-per-round
+        # LFProc's timings ARE this round's read/decode wait and output
+        # writes; the parallel.place span delta is the explicit H2D
+        # placement; compute is the remainder (kernel dispatch through
+        # host sync plus engine glue)
+        assemble_s = float(lfp.timings.get("assemble_s", 0.0))
+        write_s = float(lfp.timings.get("write_s", 0.0))
+        place_s = max(_place_span_seconds(reg) - place0, 0.0)
+        ph.add("read_decode", assemble_s)
+        ph.add("place", place_s)
+        ph.add("commit", write_s)
+        ph.add(
+            "compute",
+            max(proc_wall - assemble_s - write_s - place_s, 0.0),
+        )
         self.prev_t2 = t2
         self.rounds = rnd
         self.round_rt = (
@@ -836,35 +922,56 @@ class LowpassStreamRunner(StreamRunner):
                 "newest emitted output",
             ).set(self.head_lag)
         if self.pyramid and not _resource.should_shed("pyramid"):
-            _append_pyramid(
-                self.output_folder, rnd, emitted_patches,
-                self.pyr_state,
-            )
+            with ph.measure("pyramid"):
+                _append_pyramid(
+                    self.output_folder, rnd, emitted_patches,
+                    self.pyr_state,
+                )
         if self.detect:
             from tpudas.detect.runner import (
                 mark_detect_shed,
                 run_detect_round,
             )
 
-            if _resource.should_shed("detect"):
-                mark_detect_shed(self.det_state)
-            else:
-                run_detect_round(
-                    self.output_folder, rnd, emitted_patches,
-                    self.det_state, operators=self.detect_operators,
-                    step_sec=self.d_t,
-                )
+            with ph.measure("detect"):
+                if _resource.should_shed("detect"):
+                    mark_detect_shed(self.det_state)
+                else:
+                    run_detect_round(
+                        self.output_folder, rnd, emitted_patches,
+                        self.det_state, operators=self.detect_operators,
+                        step_sec=self.d_t,
+                    )
             self.edge_health.detect = self.det_state.get("summary")
         self.boundary.on_success()
-        self.edge_health.write(
-            self.counters, rnd, self.polls, mode_str, self.round_rt,
-            self.head_lag,
-        )
+        with ph.measure("health"):
+            self.edge_health.write(
+                self.counters, rnd, self.polls, mode_str, self.round_rt,
+                self.head_lag,
+            )
         reg.histogram(
             "tpudas_stream_round_body_seconds",
             "full processing-round wall time (index update "
             "through health write, pyramid append included)",
         ).observe(_time.perf_counter() - t_body)
+        # the round's durable trace: the phase timeline record, then
+        # ONE flush — a SIGKILL after this point leaves the whole
+        # round (its spans, then this record) in the flight ring
+        phases_rec = ph.finish(reg)
+        self._round_phases = None  # finished: never re-accumulated
+        self._flight_record(
+            "round",
+            round=rnd,
+            mode=mode_str,
+            data_seconds=round(data_sec, 3),
+            realtime_factor=round(self.round_rt, 3),
+            head_lag=(
+                None if self.head_lag is None
+                else round(self.head_lag, 3)
+            ),
+            phases=phases_rec,
+        )
+        self._flight_flush()
         if self.on_round is not None:
             self.on_round(rnd, lfp)
         self.processed_once = True
@@ -966,6 +1073,10 @@ class LowpassStreamRunner(StreamRunner):
             self.counters, self.rounds, self.polls,
             self._mode(), self.round_rt, self.head_lag,
         )
+        self._flight_record(
+            "event", name="finish", rounds=self.rounds, polls=self.polls,
+        )
+        self._flight_flush()
 
     def record_fatal(self, exc: BaseException) -> None:
         # terminal failure: the LAST health snapshot an operator sees
@@ -981,6 +1092,11 @@ class LowpassStreamRunner(StreamRunner):
             self.counters, self.rounds, self.polls,
             self._mode(), 0.0, None,
         )
+        self._flight_record(
+            "fault", fatal=True, poll=self.polls,
+            error=f"{type(exc).__name__}: {str(exc)[:300]}",
+        )
+        self._flight_flush()
 
 
 # fresh patches processed per batched-rolling chunk: bounds the host
@@ -1020,6 +1136,7 @@ class RollingStreamRunner(StreamRunner):
         self.engine = cfg.engine
         os.makedirs(self.output_folder, exist_ok=True)
         _startup_audit(self.output_folder)
+        self._init_flight(cfg)
         file_duration = (
             30.0 if cfg.file_duration is None else float(cfg.file_duration)
         )
@@ -1059,47 +1176,57 @@ class RollingStreamRunner(StreamRunner):
         from tpudas.integrity import resource as _resource
 
         self.polls += 1
+        ph = self._round_phases = RoundPhases()
         try:
-            fault_point("round.body", poll=self.polls)
-            sp = self.boundary.begin_round(
-                make_spool(self.source).sort("time"), self.source
-            )
-            sub = (
-                sp.select(distance=self.distance)
-                if self.distance is not None
-                else sp
-            )
-            contents = sub.get_contents()
-            keys = [
-                (np.datetime64(a, "ns"), np.datetime64(b, "ns"))
-                for a, b in zip(
-                    contents["time_min"], contents["time_max"]
-                )
-            ]
-            fresh = [
-                j for j, k in enumerate(keys) if k not in self.processed
-            ]
-            if (
-                not self.initial_run
-                and not fresh
-                and self.boundary.consecutive == 0
-            ):
-                print("No new data was detected. Real-time data processing ended successfully.")
-                return StepResult("terminate")
-            status = "empty"
-            if fresh:
-                status = "processed"
-                self._process_round(sub, keys, fresh)
-            self.boundary.on_success()
-            if _resource.is_degraded():
-                _resource.probe_recovery(self.output_folder)
-            self.initial_run = False
+            with flight_capture(self.flight):
+                fault_point("round.body", poll=self.polls)
+                with ph.measure("poll"):
+                    sp = self.boundary.begin_round(
+                        make_spool(self.source).sort("time"), self.source
+                    )
+                    sub = (
+                        sp.select(distance=self.distance)
+                        if self.distance is not None
+                        else sp
+                    )
+                    contents = sub.get_contents()
+                    keys = [
+                        (np.datetime64(a, "ns"), np.datetime64(b, "ns"))
+                        for a, b in zip(
+                            contents["time_min"], contents["time_max"]
+                        )
+                    ]
+                    fresh = [
+                        j for j, k in enumerate(keys)
+                        if k not in self.processed
+                    ]
+                if (
+                    not self.initial_run
+                    and not fresh
+                    and self.boundary.consecutive == 0
+                ):
+                    print("No new data was detected. Real-time data processing ended successfully.")
+                    return StepResult("terminate")
+                status = "empty"
+                if fresh:
+                    status = "processed"
+                    self._process_round(sub, keys, fresh)
+                self.boundary.on_success()
+                if _resource.is_degraded():
+                    _resource.probe_recovery(self.output_folder)
+                self.initial_run = False
         except Exception as exc:
             self.pyr_state["store"] = None
             self.det_state["pipe"] = None
             decision = self.boundary.on_failure(exc)
             if decision.propagate:
                 raise
+            self._flight_record(
+                "fault", poll=self.polls, fault_kind=decision.kind,
+                attempt=self.boundary.consecutive,
+                error=f"{type(exc).__name__}: {str(exc)[:200]}",
+            )
+            self._flight_flush()
             return StepResult(
                 "retry", decision.delay, decision.kind,
                 self.boundary.consecutive,
@@ -1109,18 +1236,25 @@ class RollingStreamRunner(StreamRunner):
     def _process_round(self, sub, keys, fresh) -> None:
         from tpudas.integrity import resource as _resource
 
+        ph = self._round_phases
+        if ph is None:
+            ph = self._round_phases = RoundPhases()
         rnd = self.rounds + 1
         print("run number: ", rnd)
         emitted_patches = []  # in-memory capture (pyramid/detect)
+        t_loop0 = _time.perf_counter()
+        write_s = [0.0]  # output writes inside the compute loop
 
         def write_out(j, out):
             out = out.new(data=np.asarray(out.data) * self.scale)
             fname = get_filename(
                 out.attrs["time_min"], out.attrs["time_max"]
             )
+            t_w0 = _time.perf_counter()
             out.io.write(
                 os.path.join(self.output_folder, fname), "dasdae"
             )
+            write_s[0] += _time.perf_counter() - t_w0
             self.processed.add(keys[j])
             if self.pyramid or self.detect:
                 emitted_patches.append(out)
@@ -1163,28 +1297,43 @@ class RollingStreamRunner(StreamRunner):
                         )
                         .mean(),
                     )
+        # phase attribution: the chunk loop is read+compute+write
+        # interleaved; writes are timed at their site, the remainder
+        # is compute (rolling reads inside .rolling()/.mean())
+        loop_wall = _time.perf_counter() - t_loop0
+        ph.add("commit", write_s[0])
+        ph.add("compute", max(loop_wall - write_s[0], 0.0))
         # driver parity with the lowpass runner: the same per-round
         # serve/detect append hooks over the same in-memory capture
         if self.pyramid and not _resource.should_shed("pyramid"):
-            _append_pyramid(
-                self.output_folder, rnd, emitted_patches,
-                self.pyr_state,
-            )
+            with ph.measure("pyramid"):
+                _append_pyramid(
+                    self.output_folder, rnd, emitted_patches,
+                    self.pyr_state,
+                )
         if self.detect:
             from tpudas.detect.runner import (
                 mark_detect_shed,
                 run_detect_round,
             )
 
-            if _resource.should_shed("detect"):
-                mark_detect_shed(self.det_state)
-            else:
-                run_detect_round(
-                    self.output_folder, rnd, emitted_patches,
-                    self.det_state, operators=self.detect_operators,
-                    step_sec=self.step_sec,
-                )
+            with ph.measure("detect"):
+                if _resource.should_shed("detect"):
+                    mark_detect_shed(self.det_state)
+                else:
+                    run_detect_round(
+                        self.output_folder, rnd, emitted_patches,
+                        self.det_state, operators=self.detect_operators,
+                        step_sec=self.step_sec,
+                    )
         self.rounds = rnd
+        phases_rec = ph.finish()
+        self._round_phases = None  # finished: never re-accumulated
+        self._flight_record(
+            "round", round=rnd, mode="rolling",
+            patches=len(fresh), phases=phases_rec,
+        )
+        self._flight_flush()
 
 
 def build_runner(

@@ -73,7 +73,7 @@ class _FleetStream:
     __slots__ = (
         "spec", "runner", "status", "error", "next_due", "deficit",
         "steps", "wall_seconds", "probe_due", "probe_interval",
-        "probes", "unparks",
+        "probes", "unparks", "parked_at", "unparked_at",
     )
 
     def __init__(self, spec: StreamSpec, runner: StreamRunner | None):
@@ -91,6 +91,10 @@ class _FleetStream:
         self.probe_interval = None
         self.probes = 0
         self.unparks = 0
+        # wall-clock park/unpark event times (ISSUE 13): surfaced in
+        # health.json's `fleet` sub-object and the /fleet/healthz rollup
+        self.parked_at = None
+        self.unparked_at = None
 
     @property
     def stream_id(self) -> str:
@@ -267,6 +271,7 @@ class FleetEngine:
     def _park(self, s: _FleetStream, exc: BaseException) -> None:
         s.status = "parked"
         s.error = f"{type(exc).__name__}: {str(exc)[:300]}"
+        s.parked_at = _time.time()
         # schedule the unpark re-probe (doubling interval, bounded
         # attempts — the quarantine probe policy, stream-sized)
         if self.unpark_probe is not None and (
@@ -284,6 +289,8 @@ class FleetEngine:
             if health is not None:
                 health.extra["fleet"] = {
                     "event": "parked",
+                    "parked_at": s.parked_at,
+                    "unparked_at": s.unparked_at,
                     "unparks": s.unparks,
                     "error": s.error,
                 }
@@ -334,10 +341,13 @@ class FleetEngine:
         s.deficit = 0.0
         s.probe_due = None
         s.unparks += 1
+        s.unparked_at = _time.time()
         health = getattr(runner, "edge_health", None)
         if health is not None:
             health.extra["fleet"] = {
                 "event": "unparked",
+                "parked_at": s.parked_at,
+                "unparked_at": s.unparked_at,
                 "unparks": s.unparks,
                 "probes": s.probes,
             }
@@ -458,6 +468,8 @@ class FleetEngine:
                 ),
                 "head_lag_seconds": getattr(r, "head_lag", None),
                 "unparks": s.unparks,
+                "parked_at": s.parked_at,
+                "unparked_at": s.unparked_at,
                 "error": s.error,
             }
         return {

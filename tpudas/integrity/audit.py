@@ -23,6 +23,10 @@ artifact            files
 ``scores``          ``.detect/scores/manifest.json`` (+ ``.prev``),
                     ``.detect/scores/tails.npy``,
                     ``.detect/scores/NNNNNNNN.npy``
+``flight``          ``.flight/seg-NNNNNNNN.jsonl`` — the crash-surviving
+                    flight recorder's segments (per-line crc32 stamps;
+                    a SIGKILL-torn tail is truncated to the verified
+                    prefix — ISSUE 13)
 ``tmp``             any ``*.tmp`` / ``*.tmp.<pid>`` leftover anywhere in
                     the tree (a crashed writer's half file)
 ==================  =====================================================
@@ -620,6 +624,59 @@ def _check_pyramid(
 
 
 # ---------------------------------------------------------------------------
+# flight recorder segments (tpudas.obs.flight, ISSUE 13)
+
+
+def _check_flight(folder: str, issues: list, repair: bool) -> None:
+    """The flight ring's crash windows: a SIGKILL mid-flush tears the
+    tail of the newest segment (per-line crc catches it); bit rot can
+    corrupt any line.  Repair truncates each segment to its verified
+    prefix — exactly what every reader already skips to — and removes
+    a segment with no verified lines at all.  The trace is bounded,
+    derived observability data: truncation loses nothing the readers
+    could have used."""
+    from tpudas.obs.flight import SEGMENT_RE, flight_dir, scan_segment
+    from tpudas.utils.atomicio import atomic_write_text
+
+    fdir = flight_dir(folder)
+    if not os.path.isdir(fdir):
+        return
+    for name in sorted(os.listdir(fdir)):
+        if not SEGMENT_RE.match(name):
+            continue
+        path = os.path.join(fdir, name)
+        try:
+            _records, good_lines, bad = scan_segment(path)
+        except OSError as exc:
+            if repair:
+                _remove_all(path)
+            _issue(
+                issues, "flight", path, "corrupt",
+                _repair_action(repair, "removed"),
+                f"{type(exc).__name__}: {str(exc)[:120]}",
+            )
+            continue
+        if not bad:
+            continue
+        if good_lines:
+            if repair:
+                atomic_write_text(path, "\n".join(good_lines) + "\n")
+            _issue(
+                issues, "flight", path, "torn",
+                _repair_action(repair, "truncated"),
+                f"{bad} unverifiable line(s) dropped",
+            )
+        else:
+            if repair:
+                _remove_all(path)
+            _issue(
+                issues, "flight", path, "torn",
+                _repair_action(repair, "removed"),
+                "no verifiable lines",
+            )
+
+
+# ---------------------------------------------------------------------------
 # detect artifacts (tpudas.detect: carry + events ledger + score tiles)
 
 
@@ -951,6 +1008,7 @@ def audit(folder, repair: bool = True, rebuild: bool = True) -> dict:
             _check_outputs(folder, issues, repair)
             _check_pyramid(folder, issues, repair, rebuild)
             _check_detect(folder, issues, repair)
+            _check_flight(folder, issues, repair)
     elapsed = time.perf_counter() - t0
     reg = get_registry()
     reg.counter(

@@ -13,10 +13,30 @@ needs first-class run introspection):
   ``metrics.prom`` snapshots the realtime driver drops beside the
   stream carry (``TPUDAS_HEALTH=1``) for out-of-process scraping.
 
+Cluster observability (ISSUE 13) adds three more:
+
+- :mod:`tpudas.obs.flight` — the crash-surviving flight recorder: a
+  bounded, segmented, crc-stamped on-disk ring of spans / round-phase
+  records / faults beside the stream carry (``TPUDAS_FLIGHT=0``
+  disables);
+- :mod:`tpudas.obs.phases` — the round-phase timeline: per-round wall
+  seconds by named phase
+  (``tpudas_stream_round_phase_seconds{phase}``);
+- :mod:`tpudas.obs.collect` — the cluster rollup: fleet + backfill +
+  serve-pool state folded into one snapshot with per-stream freshness
+  SLO status (``tools/obs_report.py``, ``GET /slo``, ``GET /trace``).
+
 Metric catalog and conventions: ``OBSERVABILITY.md`` (linted by
 ``tools/check_metrics.py``).  Kill-switch: ``TPUDAS_OBS=0``.
 """
 
+from tpudas.obs.collect import (
+    SLOPolicy,
+    cluster_snapshot,
+    fleet_rollup,
+    slo_status,
+)
+from tpudas.obs.flight import FlightRecorder, read_flight
 from tpudas.obs.health import (
     HEALTH_FILENAME,
     HEALTH_SCHEMA_VERSION,
@@ -25,6 +45,7 @@ from tpudas.obs.health import (
     write_health,
     write_prom,
 )
+from tpudas.obs.phases import PHASES, RoundPhases
 from tpudas.obs.registry import (
     MetricsRegistry,
     get_registry,
@@ -44,6 +65,14 @@ __all__ = [
     "write_health",
     "read_health",
     "write_prom",
+    "FlightRecorder",
+    "read_flight",
+    "PHASES",
+    "RoundPhases",
+    "SLOPolicy",
+    "slo_status",
+    "fleet_rollup",
+    "cluster_snapshot",
     "HEALTH_FILENAME",
     "PROM_FILENAME",
     "HEALTH_SCHEMA_VERSION",

@@ -1,0 +1,356 @@
+"""Cluster-wide observability rollup: one snapshot over a fleet root,
+a backfill queue root, and a serve-pool control plane.
+
+PRs 8-12 made the system a cluster — a FleetEngine of N streams, a
+ServePool of N worker processes, backfill workers across hosts — but
+every obs artifact stayed per-process: each stream's ``health.json`` /
+``metrics.prom`` / flight ring beside its own carry, each pool worker
+its own registry.  This module is the read side that folds them into
+ONE operator view (FiLark's end-to-end streaming framing needs
+end-to-end freshness visibility):
+
+- :func:`stream_snapshot` — one stream folder: verified health, the
+  freshness SLO status, flight-ring freshness, park/unpark events;
+- :func:`fleet_rollup` — every stream under a fleet root, with counts
+  and an overall status that is ``ok`` only when every stream is;
+- :func:`backfill_rollup` — a backfill queue root's progress (shard
+  state counts, workers seen on live leases, parked shards, result);
+- :func:`pool_rollup` — a live ServePool control plane's
+  ``/pool/healthz`` (``unreachable`` is a status, not an exception);
+- :func:`cluster_snapshot` — all of the above in one dict.
+
+**Freshness SLO.**  Per stream, :func:`slo_status` evaluates
+``head_lag_seconds`` against a target (:class:`SLOPolicy`, default
+300 s / ``TPUDAS_SLO_HEAD_LAG``) two ways: the CURRENT lag from the
+last health snapshot (``violating`` when over target), and the
+**error-budget burn** over the recent flight-ring ``round`` records —
+the fraction of recent rounds whose lag exceeded the target, divided
+by the budget ``1 - objective`` (default objective 0.99).  Burn >= 1
+means the stream is spending budget faster than the SLO allows
+(``at_risk``) even if the current round happens to be under target.
+The flight ring survives crashes, so the burn window does too.
+
+Everything here is read-only over the crash-only on-disk formats —
+run it against a live cluster or a post-mortem copy, no process
+cooperation needed.  ``tools/obs_report.py`` is the operator CLI;
+``GET /slo`` and ``/fleet/healthz`` serve the same rollup over HTTP.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass
+
+from tpudas.obs.flight import read_flight
+from tpudas.obs.health import read_health
+from tpudas.obs.trace import span
+
+__all__ = [
+    "DEFAULT_HEAD_LAG_TARGET_S",
+    "SLOPolicy",
+    "backfill_rollup",
+    "cluster_snapshot",
+    "fleet_rollup",
+    "health_entry",
+    "overall_status",
+    "pool_rollup",
+    "slo_status",
+    "stream_snapshot",
+    "worst_status",
+]
+
+DEFAULT_HEAD_LAG_TARGET_S = 300.0
+
+
+def _default_target() -> float:
+    raw = os.environ.get("TPUDAS_SLO_HEAD_LAG", "")
+    try:
+        return float(raw) if raw else DEFAULT_HEAD_LAG_TARGET_S
+    except ValueError:
+        return DEFAULT_HEAD_LAG_TARGET_S
+
+
+@dataclass(frozen=True)
+class SLOPolicy:
+    """Per-stream freshness SLO: ``head_lag_seconds`` must stay under
+    ``head_lag_target_s`` for at least ``objective`` of rounds,
+    evaluated over the newest ``window`` flight ``round`` records."""
+
+    head_lag_target_s: float | None = None  # None -> TPUDAS_SLO_HEAD_LAG/300
+    objective: float = 0.99
+    window: int = 200
+
+    def target(self) -> float:
+        return (
+            _default_target() if self.head_lag_target_s is None
+            else float(self.head_lag_target_s)
+        )
+
+
+def slo_status(folder, policy: SLOPolicy | None = None,
+               health=None, rounds=None) -> dict:
+    """One stream's freshness SLO evaluation (see the module
+    docstring).  ``health`` may pass a pre-read snapshot and
+    ``rounds`` pre-read flight ``round`` records (newest
+    ``policy.window``) to avoid scanning the same artifacts twice."""
+    policy = policy or SLOPolicy()
+    target = policy.target()
+    if health is None:
+        health = read_health(str(folder))
+    head_lag = None if health is None else health.get("head_lag_seconds")
+    if rounds is None:
+        rounds = read_flight(folder, kind="round", limit=policy.window)
+    lags = [
+        float(r["head_lag"]) for r in rounds
+        if r.get("head_lag") is not None
+    ]
+    violations = sum(1 for lag in lags if lag > target)
+    violation_frac = (violations / len(lags)) if lags else 0.0
+    budget = max(1.0 - float(policy.objective), 1e-9)
+    burn = violation_frac / budget
+    if head_lag is None and not lags:
+        status = "unknown"
+    elif head_lag is not None and head_lag > target:
+        status = "violating"
+    elif burn >= 1.0:
+        status = "at_risk"
+    else:
+        status = "ok"
+    return {
+        "status": status,
+        "head_lag_seconds": head_lag,
+        "target_s": target,
+        "objective": float(policy.objective),
+        "window_rounds": len(lags),
+        "violation_fraction": round(violation_frac, 4),
+        "error_budget_burn": round(burn, 3),
+    }
+
+
+def health_entry(health) -> dict:
+    """The per-stream rollup entry derived from one verified health
+    snapshot — the ONE health→entry mapping shared by
+    :func:`stream_snapshot` (so ``tools/obs_report.py``) and the serve
+    plane's ``/fleet/healthz``; a field added here reaches both views
+    at once.  ``None`` (no snapshot yet) reads ``unknown``."""
+    if health is None:
+        return {"status": "unknown"}
+    entry = {
+        "status": "degraded" if health.get("degraded") else "ok",
+        "rounds": health.get("rounds"),
+        "mode": health.get("mode"),
+        "realtime_factor": health.get("realtime_factor"),
+        "head_lag_seconds": health.get("head_lag_seconds"),
+        "quarantined_files": health.get("quarantined_files"),
+        "last_error": health.get("last_error"),
+        "written_at": health.get("written_at"),
+    }
+    if health.get("detect") is not None:
+        entry["detect"] = health["detect"]
+    # the fleet park/unpark event record (parked_at/unparked_at
+    # wall-clock timestamps — FleetEngine stamps them)
+    if health.get("fleet") is not None:
+        entry["fleet"] = health["fleet"]
+    return entry
+
+
+def stream_snapshot(folder, policy: SLOPolicy | None = None) -> dict:
+    """One stream folder's rollup entry: verified health + SLO +
+    flight freshness + the fleet park/unpark event (timestamps
+    included — :class:`tpudas.fleet.FleetEngine` stamps them)."""
+    folder = str(folder)
+    policy = policy or SLOPolicy()
+    health = read_health(folder)
+    entry = health_entry(health)
+    # ONE ring scan serves both the SLO window and the freshness entry
+    rounds = read_flight(folder, kind="round", limit=policy.window)
+    entry["slo"] = slo_status(
+        folder, policy, health=health, rounds=rounds
+    )
+    if rounds:
+        entry["flight"] = {
+            "last_round": rounds[-1].get("round"),
+            "last_round_at": rounds[-1].get("ts"),
+            "phases": rounds[-1].get("phases"),
+        }
+    return entry
+
+
+_STATUS_RANK = {"ok": 0, "at_risk": 1, "unknown": 2, "degraded": 3,
+                "violating": 3, "unreachable": 3}
+
+
+def worst_status(statuses) -> str:
+    """The worst of a set of rollup statuses (``ok`` < ``at_risk`` <
+    ``unknown`` < ``degraded``/``violating``/``unreachable``) — the
+    ONE ranking every aggregate view uses (``fleet_rollup``,
+    ``cluster_snapshot``, ``GET /slo``, ``tools/obs_report.py``), so
+    they can never disagree about what "worst" means."""
+    worst = "ok"
+    for s in statuses:
+        if _STATUS_RANK.get(s, 3) > _STATUS_RANK[worst]:
+            worst = s if s in _STATUS_RANK else "degraded"
+    return worst
+
+
+_worst = worst_status
+
+
+def overall_status(snap: dict) -> str:
+    """Recompute a cluster snapshot's overall status from whichever
+    planes are present — used by :func:`cluster_snapshot` itself and
+    by callers that merge extra entries afterwards (e.g.
+    ``tools/obs_report.py --stream``)."""
+    statuses = []
+    fleet = snap.get("fleet")
+    if fleet is not None:
+        statuses.append(fleet["status"])
+    bf = snap.get("backfill")
+    if bf is not None:
+        statuses.append(
+            "ok" if bf["status"] in ("done", "in_progress", "stitching")
+            else "degraded"
+        )
+    pool = snap.get("pool")
+    if pool is not None:
+        statuses.append(
+            "ok" if pool.get("status") == "ok" else "degraded"
+        )
+    return worst_status(statuses) if statuses else "unknown"
+
+
+def fleet_rollup(root, policy: SLOPolicy | None = None) -> dict:
+    """Aggregate :func:`stream_snapshot` over every stream under a
+    fleet root (the ``FleetEngine`` layout).  Overall ``status`` is
+    the worst member's; per-status counts match ``/fleet/healthz``
+    plus the SLO dimension."""
+    from tpudas.integrity.audit import fleet_stream_dirs
+
+    streams = {}
+    counts: dict = {}
+    slo_counts: dict = {}
+    for sid, path in fleet_stream_dirs(root):
+        entry = stream_snapshot(path, policy)
+        streams[sid] = entry
+        counts[entry["status"]] = counts.get(entry["status"], 0) + 1
+        s = entry["slo"]["status"]
+        slo_counts[s] = slo_counts.get(s, 0) + 1
+    if not streams:
+        return {"status": "unknown", "streams": {}, "counts": {},
+                "slo_counts": {},
+                "detail": f"no stream folders under {str(root)!r}"}
+    statuses = [e["status"] for e in streams.values()]
+    statuses += [e["slo"]["status"] for e in streams.values()]
+    return {
+        "status": _worst(statuses),
+        "streams": streams,
+        "counts": counts,
+        "slo_counts": slo_counts,
+    }
+
+
+def backfill_rollup(root) -> dict:
+    """One backfill queue root's progress: per-state shard counts,
+    workers currently holding live leases, parked shard ids, and the
+    stitched-result state.  An unreadable plan is a status, not an
+    exception (a half-provisioned root must not crash the report)."""
+    from tpudas.backfill.queue import (
+        RESULT_DONE_FILENAME,
+        BackfillQueue,
+    )
+
+    root = str(root)
+    try:
+        queue = BackfillQueue(root, worker="obs-report")
+    except Exception as exc:
+        return {
+            "status": "unreadable",
+            "error": f"{type(exc).__name__}: {str(exc)[:200]}",
+        }
+    counts = queue.counts()
+    workers = set()
+    parked = []
+    now_ns = int(time.time() * 1e9)
+    for sh in queue.plan["shards"]:
+        sid = sh["id"]
+        if queue.is_parked(sid):
+            parked.append(sid)
+        lease = queue.read_lease(sid)
+        if (
+            lease is not None
+            and int(lease.get("deadline_ns", 0)) >= now_ns
+            and not queue.is_done(sid)
+        ):
+            workers.add(str(lease.get("worker")))
+    result_done = os.path.isfile(os.path.join(root, RESULT_DONE_FILENAME))
+    total = len(queue.plan["shards"])
+    if result_done:
+        status = "done"
+    elif counts.get("parked"):
+        status = "parked"
+    elif counts.get("done") == total:
+        status = "stitching"
+    else:
+        status = "in_progress"
+    return {
+        "status": status,
+        "shards": counts,
+        "shards_total": total,
+        "done_fraction": round(counts.get("done", 0) / total, 4)
+        if total else 0.0,
+        "workers": sorted(workers),
+        "parked": parked,
+        "result_done": result_done,
+    }
+
+
+def pool_rollup(url, timeout: float = 5.0) -> dict:
+    """A live ServePool control plane's ``/pool/healthz`` payload
+    (``url`` is the control-plane base, e.g. ``http://host:9100``).
+    Unreachable is a reported status — the rollup must describe a
+    dead pool, not die with it."""
+    target = str(url).rstrip("/") + "/pool/healthz"
+    try:
+        with urllib.request.urlopen(target, timeout=timeout) as resp:
+            payload = json.loads(resp.read().decode())
+    except urllib.error.HTTPError as exc:
+        # a degraded pool answers 503 WITH a descriptive body — that
+        # is a report, not unreachability
+        try:
+            payload = json.loads(exc.read().decode())
+        except Exception:
+            return {
+                "status": "unreachable",
+                "url": target,
+                "error": f"HTTP {exc.code}",
+            }
+    except Exception as exc:
+        return {
+            "status": "unreachable",
+            "url": target,
+            "error": f"{type(exc).__name__}: {str(exc)[:200]}",
+        }
+    payload.setdefault("status", "unknown")
+    payload["url"] = target
+    return payload
+
+
+def cluster_snapshot(fleet_root=None, backfill_root=None, pool_url=None,
+                     policy: SLOPolicy | None = None) -> dict:
+    """The one cluster view: fleet + backfill + serve pool, each
+    optional, with an overall status that is ``ok`` only when every
+    present plane is healthy."""
+    with span("obs.rollup"):
+        snap: dict = {"generated_at": time.time()}
+        if fleet_root is not None:
+            snap["fleet"] = fleet_rollup(fleet_root, policy)
+        if backfill_root is not None:
+            snap["backfill"] = backfill_rollup(backfill_root)
+        if pool_url is not None:
+            snap["pool"] = pool_rollup(pool_url)
+        snap["status"] = overall_status(snap)
+    return snap

@@ -1,0 +1,142 @@
+"""Round-phase timeline: where one streaming round's wall time goes.
+
+"At-the-edge Data Processing for Low Latency High Throughput ML"
+(PAPERS.md) wins its >=10x real-time target by overlapping
+acquisition, conversion, and compute — which first requires knowing
+where the synchronous round loop actually spends its time.  This
+module names the phases of one :meth:`StreamRunner.step` round and
+accumulates per-phase wall seconds:
+
+==============  =====================================================
+phase           what it covers (lowpass runner)
+==============  =====================================================
+``poll``        quarantine exclusion + index update + freshness check
+``read_decode`` host-side prep (LFProc construction, carry
+                resolution, index metadata) plus the in-round window
+                read / int16 decode / merge wait
+                (``LFProc.timings["assemble_s"]``)
+``place``       explicit H2D pad-and-place onto the mesh (the
+                ``parallel.place`` span time; 0 unsharded)
+``compute``     the remainder of the processing call — kernel
+                dispatch through host sync plus engine glue
+``commit``      output HDF5 writes (``timings["write_s"]``) + the
+                carry save
+``pyramid``     the per-round tile-pyramid append
+``detect``      the per-round detection hook
+``health``      the health.json / metrics.prom snapshot write
+==============  =====================================================
+
+Every processed round emits **all phases exactly once** (a skipped
+hook contributes 0.0 but is present), into:
+
+- the ``tpudas_stream_round_phase_seconds{phase=...}`` histogram —
+  the cluster-wide phase breakdown an operator scrapes; and
+- one ``kind="round"`` record in the stream's flight recorder
+  (:mod:`tpudas.obs.flight`) carrying the full per-round phase dict,
+  so the breakdown of the final rounds survives a SIGKILL.
+
+``tools/stream_bench.py`` surfaces the aggregate as a phase-breakdown
+table — the measurement substrate every future pipeline/overlap perf
+PR starts from (ROADMAP item 1).
+"""
+
+from __future__ import annotations
+
+import time
+
+from tpudas.obs.registry import get_registry
+
+__all__ = ["PHASES", "RoundPhases", "phase_seconds_snapshot"]
+
+PHASES = (
+    "poll",
+    "read_decode",
+    "place",
+    "compute",
+    "commit",
+    "pyramid",
+    "detect",
+    "health",
+)
+
+
+class _PhaseScope:
+    """Hand-rolled context manager (the span discipline: no generator
+    machinery on the round hot path)."""
+
+    __slots__ = ("rp", "phase", "_t0")
+
+    def __init__(self, rp, phase):
+        self.rp = rp
+        self.phase = phase
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.rp.add(self.phase, time.perf_counter() - self._t0)
+        return False
+
+
+class RoundPhases:
+    """One round's phase accumulator.  ``measure(phase)`` times a
+    block; ``add(phase, s)`` charges derived durations (e.g. the
+    assemble wait mirrored out of ``LFProc.timings``); ``finish()``
+    emits the histograms and returns the completed phase dict."""
+
+    __slots__ = ("seconds",)
+
+    def __init__(self):
+        self.seconds = dict.fromkeys(PHASES, 0.0)
+
+    def measure(self, phase: str) -> _PhaseScope:
+        return _PhaseScope(self, phase)
+
+    def add(self, phase: str, seconds: float) -> None:
+        self.seconds[phase] += max(float(seconds), 0.0)
+
+    def total(self) -> float:
+        return sum(self.seconds.values())
+
+    def finish(self, registry=None) -> dict:
+        """Observe every phase into
+        ``tpudas_stream_round_phase_seconds{phase}`` (all phases, every
+        round — a zero observation IS the signal that a hook was
+        skipped) and return ``{phase: seconds}`` rounded for the
+        flight record."""
+        reg = registry if registry is not None else get_registry()
+        hist = reg.histogram(
+            "tpudas_stream_round_phase_seconds",
+            "per-round wall seconds by round-loop phase (poll / "
+            "read_decode / place / compute / commit / pyramid / "
+            "detect / health)",
+            labelnames=("phase",),
+        )
+        out = {}
+        for phase in PHASES:
+            s = self.seconds[phase]
+            hist.observe(s, phase=phase)
+            out[phase] = round(s, 6)
+        return out
+
+
+def phase_seconds_snapshot(registry=None) -> dict:
+    """``{phase: {"count", "sum", "mean"}}`` from the registry's phase
+    histogram — the bench/report-side read of the timeline (empty dict
+    when no round has been instrumented)."""
+    reg = registry if registry is not None else get_registry()
+    hist = reg.get("tpudas_stream_round_phase_seconds")
+    if hist is None:
+        return {}
+    out = {}
+    for phase in PHASES:
+        snap = hist.snapshot(phase=phase)
+        if not snap["count"]:
+            continue
+        out[phase] = {
+            "count": snap["count"],
+            "sum": round(snap["sum"], 6),
+            "mean": round(snap["sum"] / snap["count"], 6),
+        }
+    return out

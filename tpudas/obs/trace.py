@@ -31,6 +31,8 @@ from tpudas.obs import registry as _registry_mod
 from tpudas.utils import logging as _logging
 
 __all__ = [
+    "add_span_sink",
+    "remove_span_sink",
     "span",
     "get_spans",
     "clear_spans",
@@ -52,6 +54,25 @@ _lock = threading.Lock()
 _ring: deque = deque(maxlen=span_ring_capacity())
 _local = threading.local()
 _next_id = 0
+# finished-span sinks (e.g. the flight recorder's thread-scoped
+# capture, tpudas.obs.flight) — called with each finished span record
+_sinks: list = []
+
+
+def add_span_sink(fn) -> None:
+    """Register ``fn(record)`` to receive every finished span (after
+    the ring append).  A raising sink is counted
+    (``tpudas_obs_spans_dropped_total{reason="sink_error"}``) and
+    skipped — a trace consumer must never break the traced code."""
+    with _lock:
+        if fn not in _sinks:
+            _sinks.append(fn)
+
+
+def remove_span_sink(fn) -> None:
+    with _lock:
+        if fn in _sinks:
+            _sinks.remove(fn)
 # jax.profiler.TraceAnnotation resolved once (None = unresolved,
 # False = unavailable/disabled) — the old device_trace re-imported jax
 # on every call; spans must not repeat that on the hot path
@@ -81,8 +102,10 @@ def _trace_annotation():
 
 
 def _span_metrics(reg):
-    """(histogram, eviction_counter) handles, memoized on the registry
-    instance — the per-span cost must not include get-or-create."""
+    """(histogram, eviction_counter, dropped_counter) handles, memoized
+    on the registry instance — the per-span cost must not include
+    get-or-create (once the ring is full, EVERY span exit counts an
+    eviction)."""
     handles = getattr(reg, "_span_metric_handles", None)
     if handles is None:
         handles = (
@@ -94,6 +117,12 @@ def _span_metrics(reg):
             reg.counter(
                 "tpudas_spans_evicted_total",
                 "finished spans dropped from the full ring buffer",
+            ),
+            reg.counter(
+                "tpudas_obs_spans_dropped_total",
+                "finished spans lost before reaching a consumer "
+                "(ring eviction, or a raising span sink)",
+                labelnames=("reason",),
             ),
         )
         try:
@@ -157,10 +186,18 @@ class _Span:
         with _lock:
             evicted = len(_ring) == _ring.maxlen
             _ring.append(rec)
-        hist, evictions = _span_metrics(self._reg)
+        hist, evictions, dropped = _span_metrics(self._reg)
         if evicted:
             evictions.inc()
+            # catalogued obs-wide name (ISSUE 13): silent trace loss
+            # must be visible in metrics.prom
+            dropped.inc(reason="ring_full")
         hist.observe(dur, name=rec["name"])
+        for sink in tuple(_sinks):
+            try:
+                sink(rec)
+            except Exception:
+                dropped.inc(reason="sink_error")
         # JSONL export through the existing pipeline (skipped wholesale
         # when no handler is installed)
         if _logging._handler is not None:

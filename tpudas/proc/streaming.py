@@ -121,6 +121,7 @@ def run_lowpass_realtime(
     detect=None,
     detect_operators=None,
     poll_jitter=None,
+    flight=None,
 ):
     """Poll ``source`` and keep the low-pass output current.
 
@@ -203,6 +204,15 @@ def run_lowpass_realtime(
     counted and skipped — it never takes down the stream.  See
     DETECTION.md.
 
+    ``flight`` (default: on, ``TPUDAS_FLIGHT=0`` disables) keeps the
+    crash-surviving flight recorder (:mod:`tpudas.obs.flight`): a
+    bounded, segmented, crc-stamped on-disk ring of the round's spans,
+    per-phase timeline records, and faults under
+    ``<output_folder>/.flight/`` — flushed once per committed round,
+    so after any SIGKILL the final rounds replay from disk
+    (``tools/crash_drill.py`` drills it; see OBSERVABILITY.md
+    "Flight recorder format").
+
     ``fault_policy`` (a :class:`tpudas.resilience.RetryPolicy`; None =
     defaults) governs the per-round fault boundary: transient/corrupt
     round failures are retried with capped exponential backoff instead
@@ -253,6 +263,7 @@ def run_lowpass_realtime(
         detect=detect,
         detect_operators=detect_operators,
         poll_jitter=poll_jitter,
+        flight=flight,
     )
     spec = StreamSpec(
         stream_id=_shim_stream_id(output_folder),
@@ -283,6 +294,7 @@ def run_rolling_realtime(
     detect=None,
     detect_operators=None,
     poll_jitter=None,
+    flight=None,
 ):
     """Poll ``source`` and rolling-mean each NEW patch (stateless per
     file — rolling_mean_dascore_edge.ipynb:209-221). Returns rounds
@@ -334,6 +346,7 @@ def run_rolling_realtime(
         detect=detect,
         detect_operators=detect_operators,
         poll_jitter=poll_jitter,
+        flight=flight,
     )
     spec = StreamSpec(
         stream_id=_shim_stream_id(output_folder),

@@ -72,6 +72,11 @@ block).  Production code marks its fault sites with
   stitch.py): a kill here orphans the fully-drained staging directory
   (swept by ``audit_backfill``) and the shard is re-executed — the
   exactly-once guarantee is the commit-wins rename, not the worker.
+- ``"obs.flight_write"`` — the flight recorder's per-round segment
+  flush (tpudas/obs/flight.py): a raise here is dropped + counted
+  (the trace must never take down the stream), and a
+  ``KeyboardInterrupt`` kill models a crash mid-flush — the readers
+  and the audit recover the segment's verified prefix.
 """
 
 from __future__ import annotations
@@ -397,6 +402,7 @@ FAULT_SITES = (
     "detect.ledger_write",
     "backfill.claim",
     "backfill.commit",
+    "obs.flight_write",
 )
 
 _ACTIONS = ("raise", "truncate", "delay")

@@ -58,7 +58,19 @@ cost.  See SERVING.md "CDN deployment".
   — the same endpoints scoped to one mounted stream.
 - ``/fleet/healthz`` — aggregate health over every mounted stream:
   per-stream status (``ok`` / ``degraded`` / ``unknown``), counts,
-  and an overall status that is ``ok`` only when every stream is.
+  per-stream ``realtime_factor`` / ``head_lag_seconds``, the
+  freshness-SLO evaluation, the fleet park/unpark event (timestamps
+  included), and an overall status that is ``ok`` only when every
+  stream is.
+- ``/trace``      — recent spans and flight records (ISSUE 13):
+  ``kind`` (default ``span``), ``name``, ``limit``.  A mounted
+  folder with a flight ring serves its crash-surviving on-disk
+  records; otherwise the in-memory span ring answers.  Control
+  plane (bypasses the admission gate).
+- ``/slo``        — per-stream freshness SLO status
+  (``tpudas.obs.collect``): current head-lag vs ``target`` plus the
+  error-budget burn over recent flight rounds (``objective``,
+  ``window``).  Control plane.
 
 ``npy`` responses carry provenance headers (``X-Tpudas-Level``,
 ``X-Tpudas-Step-Ns``, ``X-Tpudas-Source``, ``X-Tpudas-T0-Ns``, ...);
@@ -126,6 +138,47 @@ class _Mount:
         )
         self._events_cache = None
         self._score_store_cache = None
+        self._slo_cache = None
+
+
+def _slo_status_cached(mount, policy, health=None):
+    """``slo_status`` cached on the mount, keyed by the policy plus
+    the newest flight segment's ``(mtime_ns, size)`` — the expensive
+    part is scanning + crc-verifying the ring, and the ring only
+    changes when a round flushes.  A monitor polling
+    ``/fleet/healthz`` every few seconds must not re-verify megabytes
+    of JSONL per stream per request (the tile/ledger caches'
+    stat-gated discipline)."""
+    from tpudas.obs.collect import slo_status
+    from tpudas.obs.flight import segment_paths
+    from tpudas.obs.health import HEALTH_FILENAME
+
+    def _stat_key(path):
+        try:
+            st = os.stat(path)
+            return (path, st.st_mtime_ns, st.st_size)
+        except OSError:
+            return (path, None)
+
+    segs = segment_paths(mount.folder)
+    key = None
+    if segs:
+        # keyed on BOTH the newest flight segment and health.json: a
+        # stream running with TPUDAS_FLIGHT=0 over an old ring still
+        # updates health every round, and the current-lag half of the
+        # SLO must track it
+        key = (
+            policy,
+            _stat_key(segs[-1]),
+            _stat_key(os.path.join(mount.folder, HEALTH_FILENAME)),
+        )
+        cached = mount._slo_cache
+        if cached is not None and cached[0] == key:
+            return cached[1]
+    result = slo_status(mount.folder, policy, health=health)
+    if key is not None:
+        mount._slo_cache = (key, result)
+    return result
 
 
 def _load_events_cached(mount):
@@ -411,6 +464,10 @@ class _Handler(BaseHTTPRequestHandler):
                  "streams": sorted(self.server.mounts)},
             )
             return 404
+        if endpoint == "/trace":
+            return self._trace(mount, params, stream_id)
+        if endpoint == "/slo":
+            return self._slo(mount, params, stream_id)
         if endpoint in (*_DATA_ENDPOINTS, "/healthz") and mount is None:
             # fleet-only server, bare endpoint: point at the routes
             self._send_json(
@@ -451,13 +508,15 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _fleet_healthz(self) -> int:
         """Aggregate health over every mounted stream: the fleet
-        operator's one-stop liveness view.  Overall status is ``ok``
-        only when every stream has a snapshot and none is degraded;
-        any degraded stream makes the fleet ``degraded``; a stream
-        with no snapshot yet reads ``unknown`` (and the fleet is
-        ``degraded`` rather than falsely green).  Always 200 when at
-        least one stream is mounted — a degraded fleet must still be
-        inspectable — and 503 with no mounts at all."""
+        operator's one-stop liveness view.  Per-stream entries use
+        the SAME health→entry mapping and worst-first status ranking
+        as ``tpudas.obs.collect`` (``ok`` < ``at_risk`` < ``unknown``
+        < ``degraded``/``violating``), folding in each stream's
+        freshness-SLO status — so this endpoint and
+        ``tools/obs_report.py`` can never disagree; overall is ``ok``
+        only when every stream's health AND SLO are.  Always 200 when
+        at least one stream is mounted — a degraded fleet must still
+        be inspectable — and 503 with no mounts at all."""
         mounts = self.server.mounts
         if not mounts:
             self._send_json(
@@ -467,35 +526,39 @@ class _Handler(BaseHTTPRequestHandler):
                            "DASServer(streams=...) or .for_fleet)"},
             )
             return 503
+        from tpudas.obs.collect import (
+            SLOPolicy,
+            health_entry,
+            worst_status,
+        )
+
+        policy = SLOPolicy()
         streams = {}
         counts = {"ok": 0, "degraded": 0, "unknown": 0}
+        slo_counts: dict = {}
         for sid in sorted(mounts):
             payload = read_health(mounts[sid].folder)
-            if payload is None:
-                status = "unknown"
-                entry = {"status": status}
-            else:
-                status = (
-                    "degraded" if payload.get("degraded") else "ok"
-                )
-                entry = {
-                    "status": status,
-                    "rounds": payload.get("rounds"),
-                    "mode": payload.get("mode"),
-                    "realtime_factor": payload.get("realtime_factor"),
-                    "head_lag_seconds": payload.get("head_lag_seconds"),
-                    "quarantined_files": payload.get(
-                        "quarantined_files"
-                    ),
-                    "last_error": payload.get("last_error"),
-                    "written_at": payload.get("written_at"),
-                }
+            entry = health_entry(payload)
+            status = entry["status"]
+            entry["slo"] = _slo_status_cached(
+                mounts[sid], policy, health=payload
+            )
+            slo_counts[entry["slo"]["status"]] = (
+                slo_counts.get(entry["slo"]["status"], 0) + 1
+            )
             counts[status] += 1
             streams[sid] = entry
-        overall = "ok" if counts["ok"] == len(streams) else "degraded"
+        # the SAME worst-first ranking over health AND SLO statuses as
+        # tpudas.obs.collect.fleet_rollup — the HTTP monitor and
+        # tools/obs_report.py must never disagree about the fleet
+        overall = worst_status(
+            [e["status"] for e in streams.values()]
+            + [e["slo"]["status"] for e in streams.values()]
+        )
         self._send_json(
             200,
-            {"status": overall, "streams": streams, "counts": counts},
+            {"status": overall, "streams": streams, "counts": counts,
+             "slo_counts": slo_counts},
         )
         return 200
 
@@ -504,6 +567,86 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(
             200, text.encode(), "text/plain; version=0.0.4; charset=utf-8"
         )
+        return 200
+
+    def _trace(self, mount, params: dict, stream_id=None) -> int:
+        """Recent spans (and other flight records), filterable — the
+        operator's post-hoc "what was the stream doing" view (ISSUE
+        13).  A mounted folder with a flight ring serves its
+        crash-surviving on-disk records; otherwise the process's
+        in-memory span ring answers.  Control plane: bypasses the
+        admission gate like ``/healthz`` — tracing a saturated server
+        is the point."""
+        from tpudas.obs.flight import read_flight, segment_paths
+        from tpudas.obs.trace import get_spans
+
+        kind = params.get("kind", "span")
+        name = params.get("name") or None
+        limit = int(params.get("limit", 256))
+        if limit < 1:
+            raise ValueError(f"limit must be positive, got {limit}")
+        limit = min(limit, 5000)
+        with span("serve.trace", stream=stream_id or ""):
+            if mount is not None and segment_paths(mount.folder):
+                records = read_flight(
+                    mount.folder, kind=kind or None, name=name,
+                    limit=limit,
+                )
+                source = "flight"
+            else:
+                records = get_spans(name)
+                if kind and kind != "span":
+                    records = []
+                records = records[-limit:]
+                source = "ring"
+        self._send_json(
+            200,
+            {"source": source, "kind": kind or None, "name": name,
+             "count": len(records), "records": records},
+        )
+        return 200
+
+    def _slo(self, mount, params: dict, stream_id=None) -> int:
+        """Per-stream freshness SLO status (tpudas.obs.collect): the
+        current head-lag vs target plus the error-budget burn over
+        the flight ring's recent rounds.  Bare on a fleet server =
+        every mounted stream; scoped = one stream."""
+        from tpudas.obs.collect import SLOPolicy, worst_status
+
+        window = int(params.get("window", 200))
+        if window < 1:
+            raise ValueError(f"window must be positive, got {window}")
+        objective = float(params.get("objective", 0.99))
+        if not 0.0 < objective <= 1.0:
+            raise ValueError(
+                f"objective must be in (0, 1], got {objective}"
+            )
+        policy = SLOPolicy(
+            head_lag_target_s=(
+                float(params["target"]) if "target" in params else None
+            ),
+            objective=objective,
+            window=window,
+        )
+        with span("serve.slo", stream=stream_id or ""):
+            if stream_id is not None or (
+                mount is not None and not self.server.mounts
+            ):
+                payload = _slo_status_cached(mount, policy)
+            else:
+                streams = {
+                    sid: _slo_status_cached(m, policy)
+                    for sid, m in sorted(self.server.mounts.items())
+                }
+                if mount is not None:
+                    streams["."] = _slo_status_cached(mount, policy)
+                payload = {
+                    "status": worst_status(
+                        e["status"] for e in streams.values()
+                    ),
+                    "streams": streams,
+                }
+        self._send_json(200, payload)
         return 200
 
     # -- data plane ----------------------------------------------------

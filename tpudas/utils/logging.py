@@ -55,10 +55,19 @@ def _record_drop(name: str, exc: Exception) -> None:
         # lazy import: tpudas.obs.trace imports log_event back
         from tpudas.obs.registry import get_registry
 
-        get_registry().counter(
+        reg = get_registry()
+        reg.counter(
             "tpudas_log_event_drops_total",
             "log_event handler exceptions swallowed",
         ).inc()
+        # catalogued obs-wide alias (ISSUE 13): silent event loss must
+        # be visible in metrics.prom next to the flight-recorder drops
+        reg.counter(
+            "tpudas_obs_events_dropped_total",
+            "observability events lost before reaching their sink "
+            "(log_event handler failures, flight-recorder drops)",
+            labelnames=("reason",),
+        ).inc(reason="handler")
     except Exception:
         pass  # the drop counter must not introduce its own crash path
     if not _drop_warned:
