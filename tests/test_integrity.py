@@ -865,6 +865,26 @@ class TestCrashDrill:
         assert rep["detect_match"], rep
         assert rep["ok"]
 
+    def test_smoke_async_ingest_drill(self):
+        """Tier-1 smoke of the --async-ingest drill leg (ISSUE 15): a
+        seeded SIGKILL cycle with the prefetch pipeline on (drilled
+        workers run TPUDAS_INGEST_PREFETCH=2, the control replay runs
+        the synchronous loop) ends audit-clean and byte-identical —
+        prefetched-but-uncommitted slices are crash-equivalent to
+        never-read, and the async path's durable bytes equal the
+        sync path's."""
+        from tools.crash_drill import run_drill
+
+        rep = run_drill(
+            engine="cascade", cycles=1, seed=9, async_ingest=True
+        )
+        assert rep["async_ingest"] is True
+        assert rep["audit_clean"], rep
+        assert rep["outputs_match"], rep
+        assert rep["pyramid_match"], rep
+        assert rep["detect_match"], rep
+        assert rep["ok"]
+
     @pytest.mark.slow
     @pytest.mark.parametrize("engine", ["cascade", "fft", "fused"])
     @pytest.mark.parametrize("mesh", [0, 4])
@@ -873,6 +893,16 @@ class TestCrashDrill:
 
         rep = run_drill(engine=engine, cycles=25, seed=0, mesh=mesh)
         assert rep["kills"] >= 15, rep  # most cycles really died
+        assert rep["ok"], rep
+
+    @pytest.mark.slow
+    def test_full_async_ingest_drill(self):
+        from tools.crash_drill import run_drill
+
+        rep = run_drill(
+            engine="cascade", cycles=12, seed=0, async_ingest=True
+        )
+        assert rep["kills"] >= 6, rep
         assert rep["ok"], rep
 
 

@@ -142,6 +142,16 @@ REQUIRED_METRICS = (
     "tpudas_obs_flight_torn_records_total",
     "tpudas_obs_spans_dropped_total",
     "tpudas_obs_events_dropped_total",
+    # async pipelined ingest (PR 15): tools/stream_bench.py's --pr15
+    # A/B reads these to prove the overlap, and the PERF.md
+    # "Pipelined ingest" runbook points dashboards at them
+    "tpudas_stream_ingest_depth",
+    "tpudas_stream_ingest_queue_peak",
+    "tpudas_stream_ingest_prefetched_total",
+    "tpudas_stream_ingest_hits_total",
+    "tpudas_stream_ingest_misses_total",
+    "tpudas_stream_ingest_stall_seconds_total",
+    "tpudas_stream_ingest_host_dequant_total",
 )
 REQUIRED_SPANS = (
     "serve.request",
@@ -167,6 +177,7 @@ REQUIRED_SPANS = (
     "obs.rollup",
     "serve.trace",
     "serve.slo",
+    "stream.prefetch",
 )
 
 

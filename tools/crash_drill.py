@@ -81,6 +81,14 @@ and pyramids through one process and one SIGKILL, but each stream
 must crash-resume exactly as if it ran alone.  (``--streams`` and
 ``--mesh`` are mutually exclusive.)
 
+``--async-ingest`` (ISSUE 15) drills the ASYNC PIPELINED INGEST
+path: every drilled cycle runs with ``TPUDAS_INGEST_PREFETCH=2`` (so
+SIGKILLs land with prefetched-but-uncommitted slices in flight and
+with deferred-sync blocks pending) while the control replay runs the
+synchronous slice loop — the byte-identity comparison then proves
+both that a prefetched slice is crash-equivalent to a never-read one
+AND that the async path's durable bytes equal the sync path's.
+
 ``tests/test_integrity.py`` runs a small seeded smoke in tier-1 and
 the full drill under ``-m slow``; ``tests/test_fleet.py`` smokes the
 fleet drill.
@@ -222,16 +230,20 @@ def _rm_ready(out: str) -> None:
 
 
 def _run_cycle(src, out, engine, kill_after, log_fh=None,
-               mesh=0, streams=0) -> dict:
+               mesh=0, streams=0, env_extra=None) -> dict:
     """One worker subprocess; ``kill_after`` seconds after READY send
     SIGKILL (None = let it finish).  ``mesh`` > 0 runs the worker
     channel-sharded over that many CPU-virtualized devices
     (``TPUDAS_MESH`` + ``--xla_force_host_platform_device_count``) —
     the driver resolves the env var itself.  ``streams`` > 0 runs the
     FLEET worker (``src`` is then the source root holding one spool
-    per stream).  Returns {killed, wall}."""
+    per stream).  ``env_extra`` overlays the worker environment (the
+    async-ingest leg pins ``TPUDAS_INGEST_PREFETCH`` per side).
+    Returns {killed, wall}."""
     _rm_ready(out)
     env = dict(os.environ)
+    if env_extra:
+        env.update(env_extra)
     env["JAX_PLATFORMS"] = "cpu"
     if mesh:
         env["TPUDAS_MESH"] = str(int(mesh))
@@ -430,6 +442,7 @@ def run_drill(
     files_per_cycle: int = 1,
     log_path: str | None = None,
     mesh: int = 0,
+    async_ingest: bool = False,
 ) -> dict:
     """One full drill for ``engine``; returns the report dict with
     ``ok`` True when the audit is clean and both comparisons match.
@@ -438,7 +451,14 @@ def run_drill(
     over that many CPU-virtualized devices while the CONTROL replay
     stays single-device — so one drill proves both that SIGKILL
     cycles on the sharded path end audit-clean AND that the sharded
-    path is byte-identical to the unsharded cascade/fft."""
+    path is byte-identical to the unsharded cascade/fft.
+
+    ``async_ingest`` (ISSUE 15) runs every DRILLED cycle with the
+    async pipelined ingest on (``TPUDAS_INGEST_PREFETCH=2``) while
+    the CONTROL replay runs the synchronous slice loop — SIGKILLs
+    land with prefetched-but-uncommitted slices in flight, and the
+    byte-identity comparison then proves a prefetched slice is
+    crash-equivalent to a never-read one."""
     import numpy as np
 
     from tpudas.integrity.audit import audit
@@ -446,21 +466,31 @@ def run_drill(
     tag = f"crash_drill_{engine}_mesh{mesh}_" if mesh else (
         f"crash_drill_{engine}_"
     )
+    if async_ingest:
+        tag = tag[:-1] + "_async_"
     workdir = workdir or tempfile.mkdtemp(prefix=tag)
     src = os.path.join(workdir, "src")
     out = os.path.join(workdir, "out")
     ctrl = os.path.join(workdir, "ctrl")
     log_fh = open(log_path, "ab") if log_path else None
+    drill_env = (
+        {"TPUDAS_INGEST_PREFETCH": "2"} if async_ingest else None
+    )
+    ctrl_env = (
+        {"TPUDAS_INGEST_PREFETCH": "0"} if async_ingest else None
+    )
     try:
         # epochs: every feed event, replayed verbatim for the control
         epochs = [(0, files_init)]
         _feed(src, 0, files_init)
         # cold calibration: seeds the carry AND the shared XLA cache
-        cold = _run_cycle(src, out, engine, None, log_fh, mesh=mesh)
+        cold = _run_cycle(src, out, engine, None, log_fh, mesh=mesh,
+                          env_extra=drill_env)
         # warm calibration: the est the kill distribution draws from
         epochs.append((files_init, files_per_cycle))
         _feed(src, files_init, files_per_cycle)
-        warm = _run_cycle(src, out, engine, None, log_fh, mesh=mesh)
+        warm = _run_cycle(src, out, engine, None, log_fh, mesh=mesh,
+                          env_extra=drill_env)
         est = max(warm["wall"], 0.2)
         rng = np.random.default_rng(seed)
         n_files = files_init + files_per_cycle
@@ -474,7 +504,7 @@ def run_drill(
                 n_files += files_per_cycle
             kill_after = float(rng.uniform(0.02, est * 0.95))
             r = _run_cycle(src, out, engine, kill_after, log_fh,
-                           mesh=mesh)
+                           mesh=mesh, env_extra=drill_env)
             kills += int(r["killed"])
             advance = not r["killed"]
             if not r["killed"]:
@@ -487,7 +517,8 @@ def run_drill(
         # already replay the final committed round's spans + phases
         flight = _flight_replay_check(out)
         # drain: the resumed run finishes everything the kills left
-        _run_cycle(src, out, engine, None, log_fh, mesh=mesh)
+        _run_cycle(src, out, engine, None, log_fh, mesh=mesh,
+                   env_extra=drill_env)
         # the drained folder must audit clean (each worker already
         # audited at startup; this run may not find anything new)
         report = audit(out, repair=True)
@@ -497,7 +528,8 @@ def run_drill(
         ctrl_src = os.path.join(workdir, "ctrl_src")
         for first, count in epochs:
             _feed(ctrl_src, first, count)
-            _run_cycle(ctrl_src, ctrl, engine, None, log_fh)
+            _run_cycle(ctrl_src, ctrl, engine, None, log_fh,
+                       env_extra=ctrl_env)
         outputs_match = _content_hash(out) == _content_hash(ctrl)
         pyr_out, pyr_ctrl = _pyramid_tree(out), _pyramid_tree(ctrl)
         pyramid_match = pyr_out == pyr_ctrl
@@ -511,6 +543,7 @@ def run_drill(
         return {
             "engine": engine,
             "mesh": int(mesh),
+            "async_ingest": bool(async_ingest),
             "cycles": int(cycles),
             "seed": int(seed),
             "kills": kills,
@@ -684,7 +717,18 @@ def main(argv=None) -> int:
         "pyramid byte-identity claim covers the compressed store "
         "(ISSUE 11)",
     )
+    ap.add_argument(
+        "--async-ingest", action="store_true",
+        help="run the DRILLED cycles with async pipelined ingest "
+        "(TPUDAS_INGEST_PREFETCH=2) while the control replay stays "
+        "synchronous — SIGKILLs land with prefetched-but-uncommitted "
+        "slices in flight, proving prefetched == never-read "
+        "(ISSUE 15); not supported with --streams",
+    )
     args = ap.parse_args(argv)
+    if args.streams and args.async_ingest:
+        ap.error("--async-ingest drills the single-stream worker; "
+                 "combine with --mesh or plain engines")
     if args.streams and args.mesh:
         ap.error("--streams and --mesh are mutually exclusive")
     if args.codec:
@@ -716,10 +760,12 @@ def main(argv=None) -> int:
             )
             continue
         print(f"crash_drill: engine={engine} cycles={args.cycles} "
-              f"seed={args.seed} mesh={args.mesh}")
+              f"seed={args.seed} mesh={args.mesh} "
+              f"async_ingest={args.async_ingest}")
         rep = run_drill(
             engine=engine, cycles=args.cycles, seed=args.seed,
             log_path=args.log, mesh=args.mesh,
+            async_ingest=args.async_ingest,
         )
         results[engine] = rep
         ok = ok and rep["ok"]
@@ -735,7 +781,8 @@ def main(argv=None) -> int:
         )
     payload = {"cycles": args.cycles, "seed": args.seed,
                "mesh": args.mesh, "streams": args.streams,
-               "codec": args.codec, "ok": ok,
+               "codec": args.codec,
+               "async_ingest": args.async_ingest, "ok": ok,
                "engines": results}
     if args.out:
         with open(args.out, "w") as fh:

@@ -55,6 +55,16 @@ carry claim: host-transfer bytes per round
 cadence (the PR 6 behavior) vs ``TPUDAS_CARRY_SAVE_EVERY`` — steady
 non-save rounds must move ZERO carry bytes to host (the
 no-host-sync-per-round check).
+
+Async pipelined ingest (ISSUE 15): ``--async 0|1`` pins
+``TPUDAS_INGEST_PREFETCH`` for any mode (the one-command overlap
+re-measurement), and ``--pr15`` runs the acceptance matrix —
+``engine="fused"`` + channel mesh, sync vs async at each ``--channels``
+width (default 2048,10000), per-mode round-phase breakdown tables and
+merged-output byte identity — into ``BENCH_pr15.json``:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+        python tools/stream_bench.py --pr15 --mesh 4
 """
 
 from __future__ import annotations
@@ -548,12 +558,17 @@ SCALE_EDGE_SEC = 16.0
 
 
 def _drive_scale(src, out, rounds, mesh, save_every=1,
-                 feed=None, on_round_extra=None):
+                 feed=None, on_round_extra=None, engine=None,
+                 patch=64, prefetch=None):
     """One scale-mode realtime run under a fresh registry.  Returns
     (registry, per-round samples): each sample holds the round's wall
     seconds, data seconds, and the cumulative host-transfer counters
     read INSIDE on_round — the per-round deltas are the
-    no-host-sync-per-round evidence."""
+    no-host-sync-per-round evidence.
+
+    ``engine`` forwards to the driver (the --pr15 mode runs "fused");
+    ``prefetch`` pins ``TPUDAS_INGEST_PREFETCH`` for this drive only
+    (None = leave the environment alone) — the async-ingest A/B."""
     from tpudas.obs.registry import MetricsRegistry, use_registry
     from tpudas.proc.streaming import run_lowpass_realtime
     from tpudas.utils.logging import set_log_handler
@@ -588,6 +603,9 @@ def _drive_scale(src, out, rounds, mesh, save_every=1,
         if on_round_extra is not None:
             on_round_extra(rnd)
 
+    prev_prefetch = os.environ.get("TPUDAS_INGEST_PREFETCH")
+    if prefetch is not None:
+        os.environ["TPUDAS_INGEST_PREFETCH"] = str(int(prefetch))
     try:
         with use_registry(reg):
             run_lowpass_realtime(
@@ -596,13 +614,14 @@ def _drive_scale(src, out, rounds, mesh, save_every=1,
                 start_time="2023-03-22T00:00:00",
                 output_sample_interval=SCALE_DT_OUT,
                 edge_buffer=SCALE_EDGE_SEC,
-                process_patch_size=64,
+                process_patch_size=patch,
                 poll_interval=0.0,
                 file_duration=0.0,
                 sleep_fn=fake_sleep,
                 max_rounds=rounds + 2,
                 counters=counters,
                 mesh=mesh,
+                engine=engine,
                 carry_save_every=save_every,
                 on_round=on_round,
                 health=False,
@@ -611,6 +630,11 @@ def _drive_scale(src, out, rounds, mesh, save_every=1,
             )
     finally:
         set_log_handler(None)
+        if prefetch is not None:
+            if prev_prefetch is None:
+                os.environ.pop("TPUDAS_INGEST_PREFETCH", None)
+            else:
+                os.environ["TPUDAS_INGEST_PREFETCH"] = prev_prefetch
     per_round = [e for e in events if e["event"] == "realtime_round"]
     for s, e in zip(samples, per_round):
         s["wall_s"] = e["wall_seconds"]
@@ -817,6 +841,180 @@ def run_scale(out_path, channels, mesh_n, rounds=4, save_every=4):
     return report
 
 
+# ---------------------------------------------------------------------------
+# pr15 mode (ISSUE 15): fused + mesh + ASYNC PIPELINED INGEST A/B
+
+# several slices per round so the prefetch pipeline has lookahead to
+# exploit: 4 files x 8 s per round, 8-output (8 s) ingest slices
+PR15_FILES_PER_ROUND = 4
+PR15_PATCH_OUT = 8
+
+
+def _print_phase_table(title, phases):
+    if not phases:
+        return
+    total = sum(p["sum"] for p in phases.values()) or 1.0
+    print(f"round-phase breakdown ({title}):")
+    print(f"  {'phase':<12}{'mean_s':>10}{'share':>8}")
+    for name, p in phases.items():
+        print(
+            f"  {name:<12}{p['mean']:>10.4f}"
+            f"{100.0 * p['sum'] / total:>7.1f}%"
+        )
+
+
+def run_pr15(out_path, channels, mesh_n, rounds=4):
+    """The ISSUE 15 acceptance bench: engine="fused" + channel mesh +
+    async pipelined ingest, A/B against the synchronous slice loop
+    (``TPUDAS_INGEST_PREFETCH=0``) at each width, with per-mode
+    round-phase breakdown tables (the before/after evidence that
+    read_decode/place overlapped into compute) and merged-output byte
+    identity between the two modes."""
+    import tempfile
+
+    from tpudas.obs.phases import (
+        ingest_pipeline_snapshot,
+        phase_seconds_snapshot,
+    )
+    from tpudas.proc.ingest import ingest_depth
+    from tpudas.testing import make_synthetic_spool
+
+    depth = max(2, ingest_depth())
+    t_bench0 = time.perf_counter()
+    try:
+        n_cores = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        n_cores = os.cpu_count() or 1
+    n_init = PR15_FILES_PER_ROUND
+    widths = []
+    for n_ch in channels:
+        with tempfile.TemporaryDirectory() as td:
+            per_mode = {}
+            for mode, pf in (("sync", 0), ("async", depth)):
+                src = os.path.join(td, f"src_{mode}")
+                out = os.path.join(td, f"out_{mode}")
+                make_synthetic_spool(
+                    src, n_files=n_init, file_duration=SCALE_FILE_SEC,
+                    fs=SCALE_FS, n_ch=n_ch, noise=0.01, format="tdas",
+                    write_kwargs={"dtype": "int16", "scale": 1e-3},
+                )
+                t0 = time.perf_counter()
+                reg, samples = _drive_scale(
+                    src, out, rounds, mesh_n, save_every=4,
+                    feed=_scale_feeder(
+                        src, n_init, PR15_FILES_PER_ROUND, n_ch
+                    ),
+                    engine="fused", patch=PR15_PATCH_OUT, prefetch=pf,
+                )
+                total = time.perf_counter() - t0
+                steady = [s["wall_s"] for s in samples[1:]]
+                steady_wall = min(steady) if steady else None
+                data_s = samples[-1]["data_s"] if samples else 0.0
+                p = _merged(out)
+                per_mode[mode] = {
+                    "steady_round_wall_s": (
+                        None if steady_wall is None
+                        else round(steady_wall, 3)
+                    ),
+                    "round_data_seconds": round(data_s, 3),
+                    "realtime_factor": (
+                        None if not steady_wall
+                        else round(data_s / steady_wall, 2)
+                    ),
+                    "rounds": len(samples),
+                    "total_wall_s": round(total, 2),
+                    "fused_rounds": reg.value(
+                        "tpudas_fir_fused_rounds_total",
+                        engine="fused-xla",
+                    ),
+                    "phase_seconds": phase_seconds_snapshot(reg),
+                    "ingest": ingest_pipeline_snapshot(reg),
+                }
+                per_mode[mode]["_patch"] = p
+            a = per_mode["sync"].pop("_patch")
+            b = per_mode["async"].pop("_patch")
+            identical = bool(
+                np.array_equal(a.host_data(), b.host_data())
+                and np.array_equal(a.coords["time"], b.coords["time"])
+            )
+            f_sync = per_mode["sync"]["realtime_factor"] or 0
+            f_async = per_mode["async"]["realtime_factor"] or 0
+            widths.append({
+                "n_ch": n_ch,
+                **per_mode,
+                "outputs_byte_identical": identical,
+                "async_speedup": (
+                    round(f_async / f_sync, 3) if f_sync else None
+                ),
+            })
+            print(f"--- n_ch={n_ch} ---")
+            _print_phase_table(
+                f"{n_ch} ch sync", per_mode["sync"]["phase_seconds"]
+            )
+            _print_phase_table(
+                f"{n_ch} ch async", per_mode["async"]["phase_seconds"]
+            )
+            print(json.dumps({
+                k: v for k, v in widths[-1].items()
+                if k in ("n_ch", "outputs_byte_identical",
+                         "async_speedup")
+            }))
+    ten_k = next((w for w in widths if w["n_ch"] >= 10000), None)
+    two_k = next((w for w in widths if w["n_ch"] == 2048), None)
+    report = {
+        "metric": "async_pipelined_ingest",
+        "config": {
+            "fs": SCALE_FS,
+            "dt_out": SCALE_DT_OUT,
+            "file_sec": SCALE_FILE_SEC,
+            "files_per_round": PR15_FILES_PER_ROUND,
+            "patch_out": PR15_PATCH_OUT,
+            "rounds": rounds,
+            "mesh": mesh_n,
+            "engine": "fused",
+            "prefetch_depth": depth,
+            "host_cores": n_cores,
+            "spool_format": "tdas int16 (in-kernel dequant)",
+        },
+        "widths": widths,
+        "all_outputs_byte_identical": all(
+            w["outputs_byte_identical"] for w in widths
+        ),
+        "realtime_factor_10k_async": (
+            None if ten_k is None
+            else ten_k["async"]["realtime_factor"]
+        ),
+        "async_speedup_10k": (
+            None if ten_k is None else ten_k["async_speedup"]
+        ),
+        "async_speedup_2048": (
+            None if two_k is None else two_k["async_speedup"]
+        ),
+        "headline_source": "tpudas.obs.registry",
+        "note": (
+            "the overlap win is bounded by spare host cores: the "
+            "prefetch thread and the XLA compute compete for the same "
+            "core when host_cores is small, so on a 1-core host the "
+            "win reduces to the work the pipeline ELIMINATES (raw "
+            "int16 ships to the device and dequantizes in-kernel — no "
+            "host astype+scale copy — and the deferred per-block sync "
+            "removes bounce latency); on multi-core edge hardware the "
+            "read_decode phase overlaps into compute entirely"
+        ),
+        "bench_wall_s": round(time.perf_counter() - t_bench0, 2),
+    }
+    with open(out_path, "w") as fh:
+        json.dump(report, fh, indent=1)
+        fh.write("\n")
+    print(json.dumps({
+        k: report[k] for k in (
+            "realtime_factor_10k_async", "async_speedup_10k",
+            "async_speedup_2048", "all_outputs_byte_identical",
+        )
+    }))
+    return report
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default=None)
@@ -835,7 +1033,34 @@ def main():
         "--save-every", type=int, default=4,
         help="deferred carry-save cadence measured by the scale sweep",
     )
+    ap.add_argument(
+        "--async", dest="async_ingest", type=int, choices=(0, 1),
+        default=None,
+        help="pin async pipelined ingest on/off for this run "
+        "(TPUDAS_INGEST_PREFETCH; default: inherit the environment) — "
+        "the one-command A/B for the overlap win",
+    )
+    ap.add_argument(
+        "--pr15", action="store_true",
+        help="ISSUE 15 acceptance bench: engine='fused' + mesh + "
+        "async-ingest A/B per width with round-phase breakdown "
+        "tables (BENCH_pr15.json)",
+    )
     args = ap.parse_args()
+    if args.async_ingest is not None:
+        os.environ["TPUDAS_INGEST_PREFETCH"] = (
+            "0" if args.async_ingest == 0 else "2"
+        )
+    if args.pr15:
+        channels = [
+            int(c) for c in (args.channels or "2048,10000").split(",")
+            if c
+        ]
+        report = run_pr15(
+            args.out or os.path.join(REPO, "BENCH_pr15.json"),
+            channels, args.mesh, rounds=args.rounds,
+        )
+        sys.exit(0 if report["all_outputs_byte_identical"] else 1)
     if args.channels:
         if args.save_every < 2:
             ap.error(

@@ -644,7 +644,13 @@ class LowpassStreamRunner(StreamRunner):
                     and self.boundary.consecutive == 0
                     and not self._more_to_drain
                 ):
-                    print("No new data was detected. Real-time processing ended successfully.")
+                    # structured, not printed: N fleet streams share
+                    # one stdout and raw prints from the timed round
+                    # body interleave (hot-loop print removal, ISSUE 15)
+                    log_event(
+                        "stream_terminated", stream=self.stream_id,
+                        rounds=self.rounds, polls=self.polls,
+                    )
                     return StepResult("terminate")
                 status = "empty"
                 if n_now > 0:
@@ -742,7 +748,7 @@ class LowpassStreamRunner(StreamRunner):
         # committed to `rounds` only when the attempt completes — a
         # failed attempt is a retry, not a processed round
         rnd = self.rounds + 1
-        print("run number: ", rnd)
+        log_event("round_start", round=rnd, stream=self.stream_id)
         if self.stateful and not self.carry_checked:
             self._resolve_carry(lfp, reg)
         # newest timestamp from the index — no file data is read
@@ -812,9 +818,10 @@ class LowpassStreamRunner(StreamRunner):
 
                 if discard_carry(self.output_folder):
                     resumed_stateful = True
-                    print(
-                        "Removed stale stream carry; rewind "
-                        "mode continues from the folder head"
+                    log_event(
+                        "stream_stale_carry_removed",
+                        stream=self.stream_id,
+                        folder=self.output_folder,
                     )
             if not self.processed_once and not resumed_stateful:
                 t1 = self.start_time
@@ -1205,7 +1212,10 @@ class RollingStreamRunner(StreamRunner):
                     and not fresh
                     and self.boundary.consecutive == 0
                 ):
-                    print("No new data was detected. Real-time data processing ended successfully.")
+                    log_event(
+                        "stream_terminated", stream=self.stream_id,
+                        rounds=self.rounds, polls=self.polls,
+                    )
                     return StepResult("terminate")
                 status = "empty"
                 if fresh:
@@ -1240,7 +1250,7 @@ class RollingStreamRunner(StreamRunner):
         if ph is None:
             ph = self._round_phases = RoundPhases()
         rnd = self.rounds + 1
-        print("run number: ", rnd)
+        log_event("round_start", round=rnd, stream=self.stream_id)
         emitted_patches = []  # in-memory capture (pyramid/detect)
         t_loop0 = _time.perf_counter()
         write_s = [0.0]  # output writes inside the compute loop
@@ -1287,7 +1297,9 @@ class RollingStreamRunner(StreamRunner):
                         write_out(j, out)
             if outs is None:
                 for j in chunk:
-                    print("working on patch ", j)
+                    log_event(
+                        "rolling_patch", index=j, stream=self.stream_id
+                    )
                     write_out(
                         j,
                         sub[j]

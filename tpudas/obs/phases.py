@@ -46,7 +46,13 @@ import time
 
 from tpudas.obs.registry import get_registry
 
-__all__ = ["PHASES", "RoundPhases", "phase_seconds_snapshot"]
+__all__ = [
+    "PHASES",
+    "RoundPhases",
+    "ingest_pipeline_snapshot",
+    "phase_seconds_snapshot",
+    "record_ingest_pipeline",
+]
 
 PHASES = (
     "poll",
@@ -119,6 +125,64 @@ class RoundPhases:
             hist.observe(s, phase=phase)
             out[phase] = round(s, 6)
         return out
+
+
+def record_ingest_pipeline(depth: int, stats: dict,
+                           registry=None) -> None:
+    """Emit one ingest pipeline's aggregate observability (called when
+    a :class:`tpudas.proc.ingest.SlicePrefetcher` closes): the
+    depth/stall gauges the overlap-aware phase reading needs —
+    ``read_decode`` now only shows the consumer's residual STALL, so
+    these are how an operator sees the producer's hidden work and
+    whether the pipeline is keeping the device fed.
+
+    ``stats`` keys: ``prefetched`` (slices loaded ahead), ``hits``
+    (validated + consumed), ``misses`` (speculation diverged —
+    discarded, re-read synchronously), ``stall_s`` (consumer seconds
+    blocked on the queue), ``max_ahead`` (peak queue occupancy)."""
+    reg = registry if registry is not None else get_registry()
+    reg.gauge(
+        "tpudas_stream_ingest_depth",
+        "configured ingest prefetch depth (TPUDAS_INGEST_PREFETCH)",
+    ).set(float(depth))
+    reg.gauge(
+        "tpudas_stream_ingest_queue_peak",
+        "peak prefetched-slice queue occupancy of the last pipeline",
+    ).set(float(stats.get("max_ahead", 0)))
+    reg.counter(
+        "tpudas_stream_ingest_prefetched_total",
+        "slices loaded ahead by the ingest prefetch thread",
+    ).inc(int(stats.get("prefetched", 0)))
+    reg.counter(
+        "tpudas_stream_ingest_hits_total",
+        "prefetched slices validated and consumed",
+    ).inc(int(stats.get("hits", 0)))
+    reg.counter(
+        "tpudas_stream_ingest_misses_total",
+        "prefetched slices discarded after cursor-speculation "
+        "mismatch (re-read synchronously; a perf signal, never a "
+        "correctness one)",
+    ).inc(int(stats.get("misses", 0)))
+    reg.counter(
+        "tpudas_stream_ingest_stall_seconds_total",
+        "consumer wall seconds blocked waiting on the prefetch queue",
+    ).inc(float(stats.get("stall_s", 0.0)))
+
+
+def ingest_pipeline_snapshot(registry=None) -> dict:
+    """The ingest pipeline counters/gauges as one dict (bench/report
+    read; zeros when no pipeline ran)."""
+    reg = registry if registry is not None else get_registry()
+    return {
+        "depth": reg.value("tpudas_stream_ingest_depth"),
+        "queue_peak": reg.value("tpudas_stream_ingest_queue_peak"),
+        "prefetched": reg.value("tpudas_stream_ingest_prefetched_total"),
+        "hits": reg.value("tpudas_stream_ingest_hits_total"),
+        "misses": reg.value("tpudas_stream_ingest_misses_total"),
+        "stall_seconds": round(
+            reg.value("tpudas_stream_ingest_stall_seconds_total"), 6
+        ),
+    }
 
 
 def phase_seconds_snapshot(registry=None) -> dict:

@@ -125,7 +125,7 @@ def fft_stream_init(edge: int, n_ch: int) -> np.ndarray:
 
 @functools.lru_cache(maxsize=128)
 def _build_fft_stream_fn(T, rows_carry, n_ch, d_sec, low, high, order,
-                         mesh, ch_axis):
+                         mesh, ch_axis, quantized=False):
     """jit-compiled FFT stream step: (block (T, C), carry (2*edge, C))
     -> (filtered (T, C), new_carry).  Both inputs are donated on
     accelerator backends (the caller never reuses either).
@@ -136,16 +136,28 @@ def _build_fft_stream_fn(T, rows_carry, n_ch, d_sec, low, high, order,
     kernel on its local channel block and the sharded result is
     byte-identical to the single-device step.  ``n_ch`` is then the
     PADDED global channel count (tpudas.parallel.sharding's
-    pad-and-mask layout)."""
+    pad-and-mask layout).
+
+    ``quantized`` compiles the raw-int16 ingest variant: the step
+    takes a traced ``qscale`` scalar and the dequantizing
+    ``cast * scale`` on the block is the program's first op (the
+    overlap-save carry stays float32 — the layouts match the float
+    variant's, so resume and mid-stream payload changes are safe)."""
     edge = rows_carry // 2
 
-    def fn(block, carry):
+    def core(block, carry):
         xc = jnp.concatenate(
-            [carry.astype(jnp.float32), block.astype(jnp.float32)],
-            axis=0,
+            [carry.astype(jnp.float32), block], axis=0,
         )
         filt = fft_pass_filter(xc, d_sec, low=low, high=high, order=order)
         return filt[edge : edge + T], xc[xc.shape[0] - 2 * edge :]
+
+    if quantized:
+        def fn(block, carry, qscale):
+            return core(block.astype(jnp.float32) * qscale, carry)
+    else:
+        def fn(block, carry):
+            return core(block.astype(jnp.float32), carry)
 
     body = fn
     if mesh is not None:
@@ -154,8 +166,9 @@ def _build_fft_stream_fn(T, rows_carry, n_ch, d_sec, low, high, order,
         from tpudas.parallel.compat import shard_map
 
         spec = P(None, ch_axis)
+        in_specs = (spec, spec, P()) if quantized else (spec, spec)
         body = shard_map(
-            fn, mesh=mesh, in_specs=(spec, spec),
+            fn, mesh=mesh, in_specs=in_specs,
             out_specs=(spec, spec), check_vma=False,
         )
     donate = (0, 1) if jax.default_backend() not in ("cpu",) else ()
@@ -163,7 +176,8 @@ def _build_fft_stream_fn(T, rows_carry, n_ch, d_sec, low, high, order,
 
 
 def fft_pass_filter_stream(block, carry, d_sec, low=None, high=None,
-                           order=4, mesh=None, ch_axis="ch"):
+                           order=4, mesh=None, ch_axis="ch",
+                           qscale=None):
     """One streaming step of the zero-phase FFT band filter.
 
     block: (T, C) new input samples; carry: (2*edge, C) from
@@ -187,7 +201,16 @@ def fft_pass_filter_stream(block, carry, d_sec, low=None, high=None,
     back verbatim and it stays resident on the mesh with no host
     round-trip; ``filtered`` is trimmed to the logical channel count.
     Byte-identical to the single-device step (the filter is
-    column-independent)."""
+    column-independent).
+
+    ``qscale`` accepts a raw int16 quantized block (tdas ingest fast
+    path): the H2D transfer stays int16 and dequantization happens
+    inside the step — bit-identical to feeding
+    ``block.astype(f32) * qscale``; the scale is a traced operand."""
+    from tpudas.ops.fir import _check_quantized
+
+    _check_quantized(block, qscale)
+    quantized = qscale is not None
     rows_carry = int(np.shape(carry)[0])
     if len(np.shape(carry)) != 2 or rows_carry % 2:
         raise ValueError(
@@ -197,9 +220,12 @@ def fft_pass_filter_stream(block, carry, d_sec, low=None, high=None,
     from tpudas.obs.trace import span
 
     edge = rows_carry // 2
+    args = (jnp.float32(qscale),) if quantized else ()
     if mesh is None:
         carry = jnp.asarray(carry, jnp.float32)
-        block = jnp.asarray(block, jnp.float32)
+        block = jnp.asarray(block)  # int16 stays int16 across H2D
+        if not quantized:
+            block = block.astype(jnp.float32)
         if block.ndim != 2 or block.shape[1] != carry.shape[1]:
             raise ValueError(
                 f"block {tuple(block.shape)} does not match carry "
@@ -208,9 +234,10 @@ def fft_pass_filter_stream(block, carry, d_sec, low=None, high=None,
         fn = _build_fft_stream_fn(
             T, rows_carry, int(block.shape[1]),
             float(d_sec), low, high, int(order), None, ch_axis,
+            quantized=quantized,
         )
         with span("op.fft_stream", rows=T, edge=edge):
-            return fn(block, carry)
+            return fn(block, carry, *args)
     from tpudas.parallel.sharding import channel_pad, place_block
 
     C = int(np.shape(block)[1])
@@ -221,7 +248,7 @@ def fft_pass_filter_stream(block, carry, d_sec, low=None, high=None,
             f"block {(T, C)} does not match carry "
             f"{tuple(np.shape(carry))}"
         )
-    xs = place_block(block, mesh, ch_axis)
+    xs = place_block(block, mesh, ch_axis, keep_dtype=quantized)
     if C_carry != Cp:
         # first call after open/resume: the carry is a host array at
         # the logical width — pad-and-place it once; every later step
@@ -229,13 +256,13 @@ def fft_pass_filter_stream(block, carry, d_sec, low=None, high=None,
         carry = place_block(np.asarray(carry, np.float32), mesh, ch_axis)
     fn = _build_fft_stream_fn(
         T, rows_carry, Cp, float(d_sec), low, high, int(order),
-        mesh, ch_axis,
+        mesh, ch_axis, quantized=quantized,
     )
     with span(
         "op.fft_stream", rows=T, edge=edge,
         shards=int(mesh.shape[ch_axis]),
     ):
-        out, new_carry = fn(xs, carry)
+        out, new_carry = fn(xs, carry, *args)
     return (out[:, :C] if Cp != C else out), new_carry
 
 

@@ -915,7 +915,7 @@ def resolve_stream_engine(engine: str, plan: CascadePlan = None,
 @functools.lru_cache(maxsize=128)
 def _build_fused_stream_fn(plan: CascadePlan, T: int, n_ch: int,
                            variant: str, mesh=None, ch_axis="ch",
-                           knobs=()):
+                           knobs=(), quantized=False):
     """jit-compiled FUSED stateful step: (x (T, C), carry) ->
     (y (T/ratio, C), new_carry) with every stage state threaded
     through one program — no per-stage HBM intermediates.
@@ -927,7 +927,15 @@ def _build_fused_stream_fn(plan: CascadePlan, T: int, n_ch: int,
     pallas_fir v3 kernel: stage tails in VMEM scratch across the
     block's grid steps).  Donation, mesh wrapping, and the sharded
     carry contract mirror :func:`_build_stream_cascade_fn`; ``knobs``
-    keys the cache on the live env fingerprint."""
+    keys the cache on the live env fingerprint.
+
+    ``quantized`` compiles the raw-int16 ingest variant: the step
+    takes a traced ``qscale`` scalar and the dequantizing
+    ``cast * scale`` is the program's first op (the stream analogue
+    of the batch path's in-kernel dequant) — the block crosses H2D
+    and is read from HBM as int16, half the bytes, no host-side f32
+    copy.  Carry leaves stay float32, so the quantized and float
+    variants share one carry layout (resume/crossover-safe)."""
     import jax
     import jax.numpy as jnp
 
@@ -946,9 +954,9 @@ def _build_fused_stream_fn(plan: CascadePlan, T: int, n_ch: int,
         )
         interpret = _pallas_interpret()
 
-        def fn(x, carry):
+        def core(x, carry):
             return fused_cascade_pallas(
-                x.astype(jnp.float32), tuple(carry), stages_np, sizes,
+                x, tuple(carry), stages_np, sizes,
                 chunk_out, interpret=interpret,
             )
 
@@ -964,14 +972,20 @@ def _build_fused_stream_fn(plan: CascadePlan, T: int, n_ch: int,
                 y = _polyphase_stage_xla(xi, hb, R, k)
             return tuple(new), y
 
-        def fn(x, carry):
-            x = x.astype(jnp.float32)
+        def core(x, carry):
             if n_steps <= 1:
                 bufs, y = step(tuple(carry), x)
                 return y, bufs
             xs = x.reshape(n_steps, chunk_in, x.shape[1])
             bufs, ys = jax.lax.scan(step, tuple(carry), xs)
             return ys.reshape(n_out_total, x.shape[1]), bufs
+
+    if quantized:
+        def fn(x, carry, qscale):
+            return core(x.astype(jnp.float32) * qscale, carry)
+    else:
+        def fn(x, carry):
+            return core(x.astype(jnp.float32), carry)
 
     body = fn
     if mesh is not None:
@@ -981,10 +995,14 @@ def _build_fused_stream_fn(plan: CascadePlan, T: int, n_ch: int,
 
         spec = P(None, ch_axis)
         carry_specs = tuple(spec for _ in sizes)
+        in_specs = (
+            (spec, carry_specs, P()) if quantized
+            else (spec, carry_specs)
+        )
         body = shard_map(
             fn,
             mesh=mesh,
-            in_specs=(spec, carry_specs),
+            in_specs=in_specs,
             out_specs=(spec, carry_specs),
             check_vma=False,
         )
@@ -1015,7 +1033,7 @@ def _count_fused(plan: CascadePlan, T: int, n_ch: int,
 @functools.lru_cache(maxsize=128)
 def _build_stream_cascade_fn(plan: CascadePlan, T: int, n_ch: int,
                              engine: str, mesh=None, ch_axis="ch",
-                             knobs=()):
+                             knobs=(), quantized=False):
     """jit-compiled stateful step: (x (T, C), carry) -> (y (T/ratio, C),
     new_carry).  Both the input block and the carry are donated on
     accelerator backends — every buffer fed in is dead the moment the
@@ -1043,8 +1061,7 @@ def _build_stream_cascade_fn(plan: CascadePlan, T: int, n_ch: int,
     use_pallas = _stream_stage_pallas(plan, T, n_ch_local, engine)
     interpret = _pallas_interpret() if any(use_pallas) else False
 
-    def fn(x, carry):
-        x = x.astype(jnp.float32)
+    def core(x, carry):
         new_carry = []
         for (R, hb), p, pall, buf in zip(blocked, sizes, use_pallas, carry):
             xc = jnp.concatenate([buf, x], axis=0) if p else x
@@ -1061,6 +1078,17 @@ def _build_stream_cascade_fn(plan: CascadePlan, T: int, n_ch: int,
             x = y
         return x, tuple(new_carry)
 
+    if quantized:
+        # raw-int16 ingest variant: the dequantizing cast * scale is
+        # the first traced op (in-kernel dequant — the batch path's
+        # contract), with the scale a traced scalar so every window
+        # scale shares one compile
+        def fn(x, carry, qscale):
+            return core(x.astype(jnp.float32) * qscale, carry)
+    else:
+        def fn(x, carry):
+            return core(x.astype(jnp.float32), carry)
+
     body = fn
     if mesh is not None:
         from jax.sharding import PartitionSpec as P
@@ -1069,10 +1097,14 @@ def _build_stream_cascade_fn(plan: CascadePlan, T: int, n_ch: int,
 
         spec = P(None, ch_axis)
         carry_specs = tuple(spec for _ in sizes)
+        in_specs = (
+            (spec, carry_specs, P()) if quantized
+            else (spec, carry_specs)
+        )
         body = shard_map(
             fn,
             mesh=mesh,
-            in_specs=(spec, carry_specs),
+            in_specs=in_specs,
             out_specs=(spec, carry_specs),
             check_vma=False,
         )
@@ -1081,7 +1113,7 @@ def _build_stream_cascade_fn(plan: CascadePlan, T: int, n_ch: int,
 
 
 def cascade_decimate_stream(x, carry, plan: CascadePlan, engine="auto",
-                            mesh=None, ch_axis="ch"):
+                            mesh=None, ch_axis="ch", qscale=None):
     """One stateful streaming step of the cascade.
 
     x: (T, C) float32 block, T a multiple of ``plan.ratio``; ``carry``
@@ -1105,9 +1137,18 @@ def cascade_decimate_stream(x, carry, plan: CascadePlan, engine="auto",
     measured size threshold; ``fused-xla``/``fused-pallas`` force a
     variant).  The carry layout is shared, so the engine may change
     between steps of one stream (cascade <-> fused crossover).
+
+    ``qscale`` accepts a raw int16 quantized block (tdas ingest fast
+    path): the H2D transfer and the first stage's HBM read stay int16
+    and dequantization happens inside the step — bit-identical to
+    feeding ``x.astype(f32) * qscale``.  The scale is a traced
+    operand (one compile serves every scale); the carry stays float32
+    either way.
     """
     import jax.numpy as jnp
 
+    _check_quantized(x, qscale)
+    quantized = qscale is not None
     T = int(np.shape(x)[0])
     n_ch = int(np.shape(x)[1])
     # size thresholds see what one device actually traces: the LOCAL
@@ -1138,14 +1179,18 @@ def cascade_decimate_stream(x, carry, plan: CascadePlan, engine="auto",
     if mesh is None:
         if fused:
             fn = _build_fused_stream_fn(plan, T, n_ch, engine,
-                                        knobs=knobs)
+                                        knobs=knobs, quantized=quantized)
             sp = span("fir.fused", rows=T, engine=engine)
         else:
             fn = _build_stream_cascade_fn(plan, T, n_ch, engine,
-                                          knobs=knobs)
+                                          knobs=knobs, quantized=quantized)
             sp = span("op.cascade_stream", rows=T, engine=engine)
+        args = (jnp.float32(qscale),) if quantized else ()
         with sp:
-            out = fn(x, tuple(jnp.asarray(b, jnp.float32) for b in carry))
+            out = fn(
+                x, tuple(jnp.asarray(b, jnp.float32) for b in carry),
+                *args,
+            )
         if fused:
             _count_fused(plan, T, n_ch, engine)
         return out
@@ -1163,7 +1208,7 @@ def cascade_decimate_stream(x, carry, plan: CascadePlan, engine="auto",
             f"matches neither the block ({C}) nor the padded shard "
             f"layout ({Cp})"
         )
-    xs = place_block(x, mesh, ch_axis)
+    xs = place_block(x, mesh, ch_axis, keep_dtype=quantized)
     if any(int(np.shape(b)[1]) != Cp for b in carry):
         # first call after open/resume: the leaves are host arrays at
         # the logical width — pad-and-place them once; every later
@@ -1171,16 +1216,17 @@ def cascade_decimate_stream(x, carry, plan: CascadePlan, engine="auto",
         carry = place_carry_leaves(carry, mesh, ch_axis)
     if fused:
         fn = _build_fused_stream_fn(plan, T, Cp, engine, mesh, ch_axis,
-                                    knobs=knobs)
+                                    knobs=knobs, quantized=quantized)
         sp = span("fir.fused", rows=T, engine=engine,
                   shards=int(mesh.shape[ch_axis]))
     else:
         fn = _build_stream_cascade_fn(plan, T, Cp, engine, mesh, ch_axis,
-                                      knobs=knobs)
+                                      knobs=knobs, quantized=quantized)
         sp = span("op.cascade_stream", rows=T, engine=engine,
                   shards=int(mesh.shape[ch_axis]))
+    args = (jnp.float32(qscale),) if quantized else ()
     with sp:
-        y, bufs = fn(xs, tuple(carry))
+        y, bufs = fn(xs, tuple(carry), *args)
     if fused:
         _count_fused(plan, T, C, engine)
     return (y[:, :C] if Cp != C else y), bufs

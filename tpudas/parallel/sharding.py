@@ -80,13 +80,19 @@ def pad_channels(x, mesh, ch_axis="ch"):
     return jnp.pad(x, widths)
 
 
-def place_block(x, mesh, ch_axis="ch"):
+def place_block(x, mesh, ch_axis="ch", keep_dtype=False):
     """Pad-and-place one (T, C) input block for the sharded stream
     step: channels split over ``ch_axis``, time replicated.  The
     explicit ``device_put`` (vs letting jit transfer lazily) keeps the
-    H2D cost visible under the ``parallel.place`` span."""
+    H2D cost visible under the ``parallel.place`` span.
+
+    ``keep_dtype=True`` places the block in its NATIVE dtype (the raw
+    int16 quantized ingest path: half the H2D bytes, dequantization
+    happens inside the first kernel); the default converts to float32
+    as every pre-quantized-path caller expects."""
     with span("parallel.place", rows=int(np.shape(x)[0])):
-        padded = pad_channels(np.asarray(x, np.float32), mesh, ch_axis)
+        host = np.asarray(x) if keep_dtype else np.asarray(x, np.float32)
+        padded = pad_channels(host, mesh, ch_axis)
         _count_transfer("place", padded.nbytes)
         return shard_channels(padded, mesh, ch_axis)
 

@@ -1,0 +1,286 @@
+"""Bounded producer/consumer prefetch for the stateful ingest path.
+
+BENCH_pr07 had the 10k-channel stream at 3.7x real-time while the
+fused kernel (BENCH_pr10) is 3.59x faster at that width — the
+bottleneck left the kernels and moved into the synchronous slice loop
+of :func:`tpudas.proc.stream.process_increment`: poll, host read +
+int16 decode, place, compute, commit, each stage idle while the
+others run.  This module is the host-side prefetch stage that turns
+that loop into a pipeline: a single producer thread reads and merges
+the NEXT ``stream.load_slice`` window (and decodes it to the
+time-major payload) while the device computes the current one,
+feeding a bounded queue into the existing ``_feed_patch`` consumer.
+
+**Byte-identity by construction.**  The slice schedule is driven by
+the carry's ingest cursor, which only advances as slices are FED — so
+the producer *speculates*: it predicts the next cursor from the slice
+it just loaded (the same ``last_sample + d`` arithmetic
+``_feed_patch`` applies, including the gap-skip and no-progress
+``t_hi + 1`` forcings) and loads ahead down that predicted chain.
+The consumer validates every handoff: a prefetched slice is used ONLY
+when its ``(t_lo, t_hi)`` window equals the window the synchronous
+loop would have loaded; any mismatch is a counted miss — the item is
+discarded, the slice is re-read synchronously, and the producer is
+resynced from the true cursor.  A used prefetched slice is therefore
+bit-identical to what the synchronous path would have read, and the
+feed order is identical by FIFO.
+
+**Crash equivalence.**  The producer only READS the source spool —
+it never touches the carry, the outputs, or any durable state — so a
+prefetched-but-unfed slice is indistinguishable from a never-read
+one: kill the process with slices in the queue and resume is
+byte-identical to a run that never prefetched (``tools/crash_drill.py
+--async-ingest`` proves it end to end, and the ``stream.prefetch``
+fault site lets tests land a ``KeyboardInterrupt`` exactly there).
+
+**Backpressure.**  At most ``depth`` slices (completed + in-flight)
+exist ahead of the consumer — the queue is the bound, the producer
+blocks before *starting* a load when the window is full.  Depth comes
+from ``TPUDAS_INGEST_PREFETCH`` (default 2; 0 restores the fully
+synchronous loop).
+
+Producer-thread observability: each load runs under the
+``stream.prefetch`` span and aggregate counters/gauges
+(``tpudas_stream_ingest_*``, :func:`tpudas.obs.phases.record_ingest_pipeline`)
+are emitted when the pipeline closes, so the round-phase table can be
+read overlap-aware (PERF.md "Pipelined ingest").
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from tpudas.obs.trace import span
+from tpudas.resilience.faults import fault_point
+
+__all__ = ["SlicePrefetcher", "decode_payload", "ingest_depth"]
+
+
+def ingest_depth() -> int:
+    """The configured prefetch depth: ``TPUDAS_INGEST_PREFETCH``
+    slices loaded ahead of the consumer (default 2; ``0`` = fully
+    synchronous slice loop, junk values degrade to the default so a
+    typo'd deployment keeps streaming)."""
+    raw = os.environ.get("TPUDAS_INGEST_PREFETCH", "")
+    if not raw:
+        return 2
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return 2
+
+
+def decode_payload(lfp, patch):
+    """(host array, qscale-or-None): the stream path's payload decode,
+    shared by the prefetch thread and the synchronous fallback so the
+    fed bytes cannot depend on which side loaded the slice.  Raw int16
+    payloads stay int16 — dequantization happens inside the first
+    device kernel (same math as the batch path's in-kernel dequant,
+    see ``tpudas.proc.lfproc._lowpass_resample_kernel``)."""
+    host, qs = lfp._time_major_payload(patch)
+    if qs is None:
+        host = np.asarray(host, np.float32)
+    else:
+        host = np.ascontiguousarray(host)
+    return host, qs
+
+
+class _Item:
+    """One prefetched slice: the window key the consumer validates
+    against, the loaded patch (None = unmergeable gap slice), the
+    decoded payload, and any exception the load raised (re-raised on
+    the consumer thread only when the window key matches)."""
+
+    __slots__ = ("t_lo_ns", "t_hi_ns", "patch", "payload", "error")
+
+    def __init__(self, t_lo_ns, t_hi_ns, patch, payload, error):
+        self.t_lo_ns = t_lo_ns
+        self.t_hi_ns = t_hi_ns
+        self.patch = patch
+        self.payload = payload
+        self.error = error
+
+
+class SlicePrefetcher:
+    """Single producer thread loading slices ahead down a speculated
+    cursor chain; see the module docstring for the protocol."""
+
+    def __init__(self, lfp, t2_ns: int, slice_ns: int, on_gap,
+                 depth: int, cursor_ns: int, d_ns_hint=None):
+        self._lfp = lfp
+        self._t2_ns = int(t2_ns)
+        self._slice_ns = int(slice_ns)
+        self._on_gap = on_gap
+        self.depth = max(1, int(depth))
+        self._cond = threading.Condition()
+        self._items: deque = deque()
+        self._state = "run"  # "run" | "pause" | "stop"
+        self._cursor = int(cursor_ns)  # None = chain broken (error)
+        self._d_hint = None if d_ns_hint is None else int(d_ns_hint)
+        self._loading = False
+        self._gen = 0  # resync generation: stale loads are discarded
+        self.stats = {
+            "prefetched": 0, "hits": 0, "misses": 0,
+            "stall_s": 0.0, "max_ahead": 0,
+        }
+        self._thread = threading.Thread(
+            target=self._run, name="tpudas-ingest-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    # -- producer -------------------------------------------------------
+    def _run(self):
+        while True:
+            with self._cond:
+                while not (
+                    self._state == "stop"
+                    or (
+                        self._state == "run"
+                        and self._cursor is not None
+                        and self._cursor <= self._t2_ns
+                        and len(self._items) < self.depth
+                    )
+                ):
+                    self._cond.wait(timeout=0.1)
+                if self._state == "stop":
+                    return
+                gen = self._gen
+                t_lo_ns = self._cursor
+                t_hi_ns = min(self._t2_ns, t_lo_ns + self._slice_ns)
+                self._loading = True
+            patch = payload = error = None
+            try:
+                t_lo = np.datetime64(int(t_lo_ns), "ns")
+                t_hi = np.datetime64(int(t_hi_ns), "ns")
+                fault_point(
+                    "stream.prefetch", t_lo=str(t_lo), t_hi=str(t_hi)
+                )
+                with span("stream.prefetch", t_lo=str(t_lo)):
+                    patch = self._lfp._load_window(
+                        t_lo, t_hi, self._on_gap
+                    )
+                    if patch is not None:
+                        payload = decode_payload(self._lfp, patch)
+            except BaseException as exc:  # shipped to the consumer —
+                # KeyboardInterrupt kills must cross the thread, too
+                error = exc
+            with self._cond:
+                self._loading = False
+                if gen != self._gen or self._state == "stop":
+                    # resynced or stopped mid-load: the slice no longer
+                    # belongs to the consumer's schedule — drop it
+                    self._cond.notify_all()
+                    continue
+                self._items.append(
+                    _Item(t_lo_ns, t_hi_ns, patch, payload, error)
+                )
+                self.stats["prefetched"] += 1
+                self.stats["max_ahead"] = max(
+                    self.stats["max_ahead"], len(self._items)
+                )
+                if error is not None:
+                    # do not speculate past a failing read: the
+                    # consumer decides (retry boundary / propagation)
+                    self._cursor = None
+                else:
+                    self._cursor = self._predict(
+                        patch, t_lo_ns, t_hi_ns
+                    )
+                self._cond.notify_all()
+
+    def _predict(self, patch, t_lo_ns: int, t_hi_ns: int):
+        """The cursor ``_feed_patch`` will leave after this slice —
+        mirrored, not shared, because the real cursor only exists
+        after the feed; every use is validated by the window-key
+        match in :meth:`get`."""
+        if patch is None:
+            return t_hi_ns + 1  # gap-skip forcing
+        t = np.asarray(patch.coords["time"])
+        if t.size == 0:
+            return t_hi_ns + 1  # no-progress forcing
+        last_ns = int(t[-1].astype("datetime64[ns]").astype(np.int64))
+        d = self._d_hint
+        if d is None:
+            d = int(round(float(patch.get_sample_step("time")) * 1e9))
+            self._d_hint = d
+        nxt = last_ns + d
+        return t_hi_ns + 1 if nxt <= t_lo_ns else nxt
+
+    # -- consumer -------------------------------------------------------
+    def get(self, t_lo_ns: int, t_hi_ns: int):
+        """The prefetched item for exactly ``[t_lo, t_hi]``, or None
+        after a MISS (speculation diverged): the queue is drained, the
+        producer parks, and the caller must load the slice itself and
+        then :meth:`resync` from the post-feed cursor.  Blocks while
+        the matching load is still in flight (the stall is charged to
+        the caller's assemble wait — the round's ``read_decode``
+        phase)."""
+        with self._cond:
+            t0 = time.perf_counter()
+            while not self._items and (
+                self._loading
+                or (
+                    self._state == "run"
+                    and self._cursor is not None
+                    and self._cursor <= self._t2_ns
+                )
+            ):
+                self._cond.wait(timeout=0.1)
+            stall = time.perf_counter() - t0
+            if stall > 0:
+                self.stats["stall_s"] += stall
+                self._lfp.timings["assemble_s"] += stall
+            if self._items:
+                item = self._items[0]
+                if (
+                    item.t_lo_ns == int(t_lo_ns)
+                    and item.t_hi_ns == int(t_hi_ns)
+                ):
+                    self._items.popleft()
+                    self._cond.notify_all()
+                    if item.error is not None:
+                        # a matched load FAILURE is neither a hit nor
+                        # a miss: surface it exactly where the
+                        # synchronous load would have raised
+                        raise item.error
+                    self.stats["hits"] += 1
+                    return item
+            # miss: the speculated chain diverged from the true cursor
+            self.stats["misses"] += 1
+            self._state = "pause"
+            self._gen += 1
+            self._items.clear()
+            while self._loading:
+                self._cond.wait(timeout=0.1)
+            return None
+
+    def resync(self, cursor_ns, d_ns_hint=None) -> None:
+        """Restart the speculation chain at the TRUE cursor (after a
+        miss was resolved synchronously, or after a mid-stream rate
+        change re-derived ``d``)."""
+        with self._cond:
+            self._gen += 1
+            self._items.clear()
+            self._cursor = None if cursor_ns is None else int(cursor_ns)
+            if d_ns_hint is not None:
+                self._d_hint = int(d_ns_hint)
+            self._state = "run"
+            self._cond.notify_all()
+
+    def close(self) -> None:
+        """Stop the producer, join it, and emit the pipeline's
+        aggregate observability (counters + depth/stall gauges —
+        :func:`tpudas.obs.phases.record_ingest_pipeline`)."""
+        with self._cond:
+            self._state = "stop"
+            self._gen += 1
+            self._cond.notify_all()
+        self._thread.join(timeout=30)
+        from tpudas.obs.phases import record_ingest_pipeline
+
+        record_ingest_pipeline(self.depth, self.stats)

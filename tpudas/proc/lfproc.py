@@ -468,7 +468,14 @@ class LFProc:
         ``shard_map`` with channels split over ``"ch"`` and the carry
         leaves stay SHARDED on the mesh between calls (pad-and-mask at
         non-divisible widths; byte-identical to the single-device run
-        — tests/test_parallel.py pins it end to end)."""
+        — tests/test_parallel.py pins it end to end).
+
+        Ingest is pipelined (``TPUDAS_INGEST_PREFETCH``, default 2): a
+        bounded prefetch thread reads + decodes the next slice while
+        the device computes the current one, and raw int16 payloads
+        dequantize inside the first kernel exactly like this class's
+        batch windows do — byte-identical to the synchronous loop
+        (PERF.md "Pipelined ingest"; tests/test_ingest.py pins it)."""
         if self._output_folder is None:
             raise Exception("Please setup output folder first")
         from tpudas.proc.stream import process_increment

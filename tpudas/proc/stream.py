@@ -38,6 +38,15 @@ emission — regenerated identically, filenames are deterministic).  A
 folder with outputs but no carry is a legacy rewind-mode folder; the
 driver falls back to rewind for it.
 
+Ingest is PIPELINED (ISSUE 15, PERF.md "Pipelined ingest"): a bounded
+prefetch thread (tpudas.proc.ingest) reads + merges + decodes the
+next slice while the device computes the current one, raw int16
+payloads ship to the device undecoded (dequantization is the first
+traced op of the stream kernels, matching the batch path), and the
+per-block host sync is deferred so placing the next donated input
+block overlaps the previous block's compute.  Feed order and math
+are byte-identical to the synchronous loop (``TPUDAS_INGEST_PREFETCH=0``).
+
 Emission alignment (shared by both engines): the output grid is
 ``start + k * step`` (ms-quantized, the batch contract).  A cold
 stream anchors at the first grid point covered by data and discards
@@ -104,7 +113,12 @@ class StreamCarry:
     ratio: int | None = None  # cascade only
     edge_in: int | None = None  # fft only: overlap-save halo, input samples
     bufs: tuple = ()
-    residual: np.ndarray | None = None  # cascade: read-but-unconsumed rows
+    residual: np.ndarray | None = None  # read-but-unconsumed rows
+    # dequant scale of the rows held in ``residual`` (None = float32
+    # rows): raw int16 payloads stay int16 end to end — host pool,
+    # H2D transfer, first kernel read — and dequantize inside the
+    # first device kernel, so the residual must remember its scale
+    residual_scale: float | None = None
     skip_left: int = 0  # outputs still to discard (warm-up + cold edge)
     next_ingest_ns: int | None = None  # next input sample to read
     next_emit_ns: int | None = None  # next output grid time to emit
@@ -132,6 +146,10 @@ class StreamCarry:
             "ratio": None if self.ratio is None else int(self.ratio),
             "edge_in": None if self.edge_in is None else int(self.edge_in),
             "n_bufs": len(self.bufs),
+            "residual_scale": (
+                None if self.residual_scale is None
+                else float(self.residual_scale)
+            ),
             "skip_left": int(self.skip_left),
             "next_ingest_ns": _opt_int(self.next_ingest_ns),
             "next_emit_ns": _opt_int(self.next_emit_ns),
@@ -178,7 +196,10 @@ def save_carry(carry: StreamCarry, folder: str) -> str:
         for i, b in enumerate(gather_leaves(carry.bufs, carry.n_ch)):
             arrays[f"buf_{i}"] = b
         if carry.residual is not None:
-            arrays["residual"] = np.asarray(carry.residual, np.float32)
+            res = np.asarray(carry.residual)
+            if res.dtype != np.int16:  # raw quantized rows stay int16
+                res = res.astype(np.float32, copy=False)
+            arrays["residual"] = res
         buf = _io.BytesIO()
         np.savez(buf, **arrays)
         rotate_prev(path)
@@ -252,6 +273,7 @@ def _parse_carry(path: str) -> StreamCarry:
             edge_in=meta["edge_in"],
             bufs=bufs,
             residual=residual,
+            residual_scale=meta.get("residual_scale"),
             skip_left=meta["skip_left"],
             next_ingest_ns=meta["next_ingest_ns"],
             next_emit_ns=meta["next_emit_ns"],
@@ -445,6 +467,36 @@ def _corner(dt: float) -> float:
     return output_corner(dt)
 
 
+class _EmitPipeline:
+    """FIFO of dispatched-but-unsynced stream blocks (the
+    double-buffer of donated input blocks): each entry is a closure
+    that syncs the block's device output and emits it.  With JAX's
+    async dispatch, deferring the host sync by ``depth`` blocks lets
+    the placement + compute of block N+1 run while block N's output
+    is synced and written — ``depth`` 0 is the classic synchronous
+    behavior (every dispatch flushed immediately).  Flushes run in
+    dispatch order on the consumer thread, so every carry/emission
+    mutation happens in exactly the synchronous sequence; an
+    exception simply abandons the un-flushed suffix, which is the
+    crash shape the resume path already reconciles (outputs are a
+    prefix of the feed order, the carry was not saved)."""
+
+    __slots__ = ("depth", "_pending")
+
+    def __init__(self, depth: int):
+        self.depth = max(0, int(depth))
+        self._pending: list = []
+
+    def push(self, flush_fn) -> None:
+        self._pending.append(flush_fn)
+        while len(self._pending) > self.depth:
+            self._pending.pop(0)()
+
+    def flush(self) -> None:
+        while self._pending:
+            self._pending.pop(0)()
+
+
 def process_increment(lfp, carry: StreamCarry, edtime) -> int:
     """Process all new data up to ``edtime`` through the carried
     filter state; write outputs; update ``carry`` in place.  Returns
@@ -453,7 +505,19 @@ def process_increment(lfp, carry: StreamCarry, edtime) -> int:
     Data is loaded in bounded time slices (one ``process_patch_size``
     window's worth of inputs each) so a large backlog never materializes
     at once; each slice flows through the stateful engine exactly once.
-    """
+
+    With ``TPUDAS_INGEST_PREFETCH`` > 0 (default 2) the slice loop is
+    a bounded producer/consumer pipeline: a host thread reads, merges
+    and decodes the NEXT slice (:class:`tpudas.proc.ingest.
+    SlicePrefetcher` — speculated, validated, byte-identical) while
+    the device computes the current one, and the per-block host sync
+    is deferred (:class:`_EmitPipeline`) so placement of the next
+    donated input block overlaps the previous block's compute.  The
+    feed order, the math, and every durable byte are identical to the
+    synchronous loop — only the wall-clock overlap changes."""
+    from tpudas.proc.ingest import SlicePrefetcher, decode_payload, \
+        ingest_depth
+
     on_gap = lfp.parameters["on_gap"]
     t2_ns = int(
         to_datetime64(edtime).astype("datetime64[ns]").astype(np.int64)
@@ -461,52 +525,99 @@ def process_increment(lfp, carry: StreamCarry, edtime) -> int:
     emitted0 = carry.emitted
     slice_ns = max(carry.patch_out, 4) * carry.step_ns
     reg = get_registry()
-    with span("stream.increment", upto=str(edtime)):
-        while True:
-            t_lo_ns = (
+    depth = ingest_depth()
+    pipe = _EmitPipeline(depth)
+    prefetcher = None
+    try:
+        with span("stream.increment", upto=str(edtime)):
+            cursor0 = (
                 carry.next_ingest_ns
                 if carry.next_ingest_ns is not None
                 else carry.start_ns
             )
-            if t_lo_ns > t2_ns:
-                break
-            t_hi_ns = min(t2_ns, t_lo_ns + slice_ns)
-            t_lo = np.datetime64(int(t_lo_ns), "ns")
-            t_hi = np.datetime64(int(t_hi_ns), "ns")
-            t0 = time.perf_counter()
-            with span("stream.load_slice"):
-                patch = lfp._load_window(t_lo, t_hi, on_gap)
-            lfp.timings["assemble_s"] += time.perf_counter() - t0
-            if patch is None:
-                # unmergeable slice under a tolerant gap policy: skip
-                # it and cold-restart the engine at the next data
-                # (stream analogue of the batch path's skipped/split
-                # windows)
-                log_event(
-                    "stream_gap_skipped", t_lo=str(t_lo), t_hi=str(t_hi)
+            if depth > 0 and cursor0 <= t2_ns:
+                prefetcher = SlicePrefetcher(
+                    lfp, t2_ns, slice_ns, on_gap, depth,
+                    cursor0, carry.d_ns,
                 )
-                reg.counter(
-                    "tpudas_stream_gap_skips_total",
-                    "stream slices skipped over unmergeable gaps",
-                ).inc()
-                _reset_engine(carry)
-                carry.next_ingest_ns = t_hi_ns + 1
+            while True:
+                t_lo_ns = (
+                    carry.next_ingest_ns
+                    if carry.next_ingest_ns is not None
+                    else carry.start_ns
+                )
+                if t_lo_ns > t2_ns:
+                    break
+                t_hi_ns = min(t2_ns, t_lo_ns + slice_ns)
+                t_lo = np.datetime64(int(t_lo_ns), "ns")
+                t_hi = np.datetime64(int(t_hi_ns), "ns")
+                payload = None
+                missed = False
+                item = (
+                    prefetcher.get(t_lo_ns, t_hi_ns)
+                    if prefetcher is not None
+                    else None
+                )
+                if item is not None:
+                    patch = item.patch
+                    payload = item.payload
+                else:
+                    # synchronous load: prefetch off, or a validated
+                    # MISS (the speculation diverged — re-read here,
+                    # resync the producer after the feed)
+                    missed = prefetcher is not None
+                    t0 = time.perf_counter()
+                    with span("stream.load_slice"):
+                        patch = lfp._load_window(t_lo, t_hi, on_gap)
+                    lfp.timings["assemble_s"] += time.perf_counter() - t0
+                    if patch is not None:
+                        payload = decode_payload(lfp, patch)
+                if patch is None:
+                    # unmergeable slice under a tolerant gap policy:
+                    # skip it and cold-restart the engine at the next
+                    # data (stream analogue of the batch path's
+                    # skipped/split windows).  Pending blocks flush
+                    # first — the reset re-anchors the emission grid.
+                    pipe.flush()
+                    log_event(
+                        "stream_gap_skipped", t_lo=str(t_lo),
+                        t_hi=str(t_hi),
+                    )
+                    reg.counter(
+                        "tpudas_stream_gap_skips_total",
+                        "stream slices skipped over unmergeable gaps",
+                    ).inc()
+                    _reset_engine(carry)
+                    carry.next_ingest_ns = t_hi_ns + 1
+                    if missed:
+                        prefetcher.resync(
+                            carry.next_ingest_ns, carry.d_ns
+                        )
+                    if t_hi_ns >= t2_ns:
+                        break
+                    continue
+                _feed_patch(lfp, carry, patch, on_gap, pipe, payload)
+                if (
+                    carry.next_ingest_ns is None
+                    or carry.next_ingest_ns <= t_lo_ns
+                ):
+                    # the slice produced no ingest progress (e.g. a
+                    # selection quirk returned only already-consumed
+                    # samples) — forcing the cursor forward beats
+                    # spinning on the same slice
+                    log_event("stream_no_progress", t_lo=str(t_lo))
+                    carry.next_ingest_ns = t_hi_ns + 1
+                if missed:
+                    prefetcher.resync(carry.next_ingest_ns, carry.d_ns)
                 if t_hi_ns >= t2_ns:
                     break
-                continue
-            _feed_patch(lfp, carry, patch, on_gap)
-            if (
-                carry.next_ingest_ns is None
-                or carry.next_ingest_ns <= t_lo_ns
-            ):
-                # the slice produced no ingest progress (e.g. a
-                # selection quirk returned only already-consumed
-                # samples) — forcing the cursor forward beats spinning
-                # on the same slice
-                log_event("stream_no_progress", t_lo=str(t_lo))
-                carry.next_ingest_ns = t_hi_ns + 1
-            if t_hi_ns >= t2_ns:
-                break
+            # every dispatched block must be written before the caller
+            # saves the carry (outputs-before-carry is the crash-only
+            # ordering contract)
+            pipe.flush()
+    finally:
+        if prefetcher is not None:
+            prefetcher.close()
     emitted = carry.emitted - emitted0
     reg.counter(
         "tpudas_stream_samples_emitted_total",
@@ -519,19 +630,30 @@ def _reset_engine(carry: StreamCarry) -> None:
     carry.kind = None
     carry.bufs = ()
     carry.residual = None
+    carry.residual_scale = None
     carry.skip_left = 0
     carry.ratio = None
     carry.edge_in = None
 
 
-def _feed_patch(lfp, carry: StreamCarry, patch, on_gap) -> None:
+def _feed_patch(lfp, carry: StreamCarry, patch, on_gap, pipe,
+                payload=None) -> None:
     """Feed one loaded window into the carried engine, emitting output
-    files for every grid point whose support is now complete."""
-    host, qs = lfp._time_major_payload(patch)
-    if qs is not None:
-        host = host.astype(np.float32) * np.float32(qs)
-    else:
-        host = np.asarray(host, np.float32)
+    files for every grid point whose support is now complete.
+
+    ``payload`` is the pre-decoded ``(host, qscale)`` pair when the
+    prefetch stage already ran the decode (``tpudas.proc.ingest.
+    decode_payload`` — the same function the synchronous fallback
+    uses, so the fed bytes cannot depend on who loaded the slice).
+    Raw int16 payloads are fed RAW: dequantization happens inside the
+    first device kernel (the batch path's contract,
+    ``lfproc._lowpass_resample_kernel``), halving the host-side copy
+    traffic and the H2D bytes."""
+    if payload is None:
+        from tpudas.proc.ingest import decode_payload
+
+        payload = decode_payload(lfp, patch)
+    host, qs = payload
     t_ns = (
         np.asarray(patch.coords["time"])
         .astype("datetime64[ns]")
@@ -541,7 +663,7 @@ def _feed_patch(lfp, carry: StreamCarry, patch, on_gap) -> None:
         return
     if carry.kind is None:
         d_sec = patch.get_sample_step("time")
-        i0 = _open_engine(lfp, carry, host, t_ns, float(d_sec))
+        i0 = _open_engine(lfp, carry, host, t_ns, float(d_sec), qs)
     else:
         if host.shape[1] != carry.n_ch:
             raise ValueError(
@@ -566,10 +688,13 @@ def _feed_patch(lfp, carry: StreamCarry, patch, on_gap) -> None:
             ).inc()
             if on_gap == "raise":
                 raise Exception("patch merge failed! Gap in data exists")
+            # pending blocks carry the PRE-GAP emission grid: flush
+            # them before the engine reset re-anchors it
+            pipe.flush()
             _reset_engine(carry)
             d_sec = patch.get_sample_step("time")
             i0 = _open_engine(
-                lfp, carry, host[i0:], t_ns[i0:], float(d_sec)
+                lfp, carry, host[i0:], t_ns[i0:], float(d_sec), qs
             ) + i0
     new = host[i0:]
     new_t = t_ns[i0:]
@@ -577,9 +702,9 @@ def _feed_patch(lfp, carry: StreamCarry, patch, on_gap) -> None:
         return
     carry.next_ingest_ns = int(new_t[-1]) + carry.d_ns
     if carry.kind == "cascade":
-        _consume_cascade(lfp, carry, patch, new)
+        _consume_cascade(lfp, carry, patch, new, qs, pipe)
     else:
-        _consume_fft(lfp, carry, patch, new, int(new_t[0]))
+        _consume_fft(lfp, carry, patch, new, int(new_t[0]), qs, pipe)
 
 
 def _grid_ceil(carry: StreamCarry, t_ns: int) -> int:
@@ -588,9 +713,14 @@ def _grid_ceil(carry: StreamCarry, t_ns: int) -> int:
     return carry.start_ns + k * carry.step_ns
 
 
-def _open_engine(lfp, carry: StreamCarry, host, t_ns, d_sec) -> int:
+def _open_engine(lfp, carry: StreamCarry, host, t_ns, d_sec,
+                 qs=None) -> int:
     """Choose and initialize the engine at the stream's first data.
-    Returns the index of the first input row to feed."""
+    Returns the index of the first input row to feed.  ``qs`` is the
+    payload's dequant scale (None = float32): the cascade's warm-up
+    prepad is created in the payload's own dtype so a quantized
+    stream's pool stays raw int16 (int16 zeros dequantize to exact
+    0.0f — identical to the float32 zeros the host path fed)."""
     d_ns = int(round(d_sec * 1e9))
     if d_ns <= 0:
         raise ValueError(f"non-positive input sample step {d_sec}")
@@ -645,12 +775,15 @@ def _open_engine(lfp, carry: StreamCarry, host, t_ns, d_sec) -> int:
         # feed origin so that stream output (warmup + k) lands on grid
         # point g_e + k*step: first fed sample at g_e - delay*d
         t_feed0 = g_e - plan.delay * d_ns
+        res_dtype = host.dtype if qs is not None else np.float32
         if t_feed0 < t0:
             prepad = (t0 - t_feed0) // d_ns
-            carry.residual = np.zeros((int(prepad), n_ch), np.float32)
+            carry.residual = np.zeros((int(prepad), n_ch), res_dtype)
+            carry.residual_scale = qs
             i0 = 0
         else:
-            carry.residual = np.zeros((0, n_ch), np.float32)
+            carry.residual = np.zeros((0, n_ch), res_dtype)
+            carry.residual_scale = qs
             i0 = int((t_feed0 - t0) // d_ns)
     else:
         from tpudas.ops.filter import fft_stream_init
@@ -745,18 +878,43 @@ def _stream_mesh(lfp):
     return mesh
 
 
-def _pool_with_residual(carry: StreamCarry, new) -> np.ndarray:
-    residual = (
-        carry.residual
-        if carry.residual is not None
-        else np.zeros((0, carry.n_ch), np.float32)
+def _pool_with_residual(carry: StreamCarry, new, qs):
+    """(pool, pool_qscale): the residual rows prepended to the fresh
+    payload.  Homogeneous payloads (same dtype, same dequant scale)
+    concatenate RAW — a quantized pool ships int16 to the device and
+    dequantizes in-kernel.  A mid-stream dtype/scale change (rare:
+    interrogator reconfiguration) degrades that one seam to a counted
+    host-side dequant so the pool stays uniform."""
+    residual = carry.residual
+    if residual is None or residual.size == 0:
+        return new, qs
+    r_qs = carry.residual_scale
+    if residual.dtype == new.dtype and (
+        (r_qs is None and qs is None)
+        or (r_qs is not None and qs is not None and float(r_qs) == float(qs))
+    ):
+        return np.concatenate([residual, new], axis=0), qs
+    get_registry().counter(
+        "tpudas_stream_ingest_host_dequant_total",
+        "stream slices dequantized on host because the payload "
+        "dtype/scale changed mid-stream (the uniform-payload fast "
+        "path dequantizes in-kernel)",
+    ).inc()
+    r = (
+        residual.astype(np.float32) * np.float32(r_qs)
+        if r_qs is not None
+        else np.asarray(residual, np.float32)
     )
-    return (
-        np.concatenate([residual, new], axis=0) if residual.size else new
+    n = (
+        new.astype(np.float32) * np.float32(qs)
+        if qs is not None
+        else np.asarray(new, np.float32)
     )
+    return np.concatenate([r, n], axis=0), None
 
 
-def _consume_cascade(lfp, carry: StreamCarry, patch, new) -> None:
+def _consume_cascade(lfp, carry: StreamCarry, patch, new, qs,
+                     pipe) -> None:
     from tpudas.ops.fir import (
         cascade_decimate_stream,
         design_cascade,
@@ -767,7 +925,7 @@ def _consume_cascade(lfp, carry: StreamCarry, patch, new) -> None:
         1e9 / carry.d_ns, carry.ratio, _corner(carry.dt_out), carry.order
     )
     mesh = _stream_mesh(lfp)
-    pool = _pool_with_residual(carry, new)
+    pool, pool_qs = _pool_with_residual(carry, new, qs)
     usable = pool.shape[0] - pool.shape[0] % carry.ratio
     pallas_ok = lfp._pallas_ok and carry.pallas_ok
     if carry.engine_req == "fused":
@@ -788,58 +946,88 @@ def _consume_cascade(lfp, carry: StreamCarry, patch, new) -> None:
     off = 0
     for n_out in _pow2_blocks(usable // carry.ratio, carry.patch_out):
         blk = pool[off : off + n_out * carry.ratio]
+        rows = int(blk.shape[0])
         stages = stream_stage_engines(
-            plan, blk.shape[0], n_ch_eng, eng_req
+            plan, rows, n_ch_eng, eng_req
         )
         if stages and stages[0].startswith("fused"):
             ran = stages[0]
         else:
             ran = "cascade-pallas" if "pallas" in stages else "cascade-xla"
-        # the stream step donates the carry on accelerators, so a
-        # fallback retry must not reuse buffers the failed dispatch
-        # already consumed — snapshot them first (Pallas blocks only)
-        backup = (
-            tuple(np.asarray(b) for b in carry.bufs)
-            if ran.endswith("pallas")
-            else None
-        )
-        t0 = time.perf_counter()
-        try:
-            y, bufs = cascade_decimate_stream(
-                blk, carry.bufs, plan, eng_req, mesh=mesh
+        if ran.endswith("pallas"):
+            # Pallas blocks keep the fully synchronous shape: the
+            # fallback chain needs the failure surfaced AT this block
+            # while the pre-dispatch carry snapshot is still valid —
+            # flush pending deferred blocks first so emission order
+            # is preserved.  The stream step donates the carry on
+            # accelerators, so the retry must not reuse buffers the
+            # failed dispatch already consumed.
+            pipe.flush()
+            backup = tuple(np.asarray(b) for b in carry.bufs)
+            t0 = time.perf_counter()
+            try:
+                y, bufs = cascade_decimate_stream(
+                    blk, carry.bufs, plan, eng_req, mesh=mesh,
+                    qscale=pool_qs,
+                )
+            except Exception as exc:
+                # mirror the batch path's Pallas resilience: a
+                # fast-path failure degrades to the XLA formulation
+                # (fused scan for a fused stream) for the rest of the
+                # run instead of killing the stream
+                fb = "fused-xla" if ran == "fused-pallas" else "xla"
+                print(
+                    "Warning: Pallas kernel failed in the stream path "
+                    f"({str(exc)[:120]}); falling back to {fb}"
+                )
+                log_event("stream_pallas_fallback", error=str(exc)[:300])
+                lfp._pallas_ok = False
+                carry.pallas_ok = False  # persists across restarts
+                eng_req = fb
+                ran = "cascade-xla" if fb == "xla" else fb
+                y, bufs = cascade_decimate_stream(
+                    blk, backup, plan, eng_req, mesh=mesh,
+                    qscale=pool_qs,
+                )
+            y = np.asarray(y)
+            t_dev = time.perf_counter() - t0
+            lfp.timings["device_s"] += t_dev
+            _count_block(rows, ran, t_dev)
+            carry.bufs = bufs
+            carry.consumed += rows
+            s = min(carry.skip_left, y.shape[0])
+            carry.skip_left -= s
+            _emit(lfp, carry, patch, y[s:], rows=rows, ran=ran,
+                  t_dev=t_dev)
+        else:
+            # deferred-sync pipeline: dispatch now (JAX queues the
+            # compute; the next block's pad-and-place overlaps it),
+            # sync + emit when the block reaches the pipeline head —
+            # same order, same math, just overlapped wall clock
+            t0 = time.perf_counter()
+            y_dev, bufs = cascade_decimate_stream(
+                blk, carry.bufs, plan, eng_req, mesh=mesh,
+                qscale=pool_qs,
             )
-        except Exception as exc:
-            # mirror the batch path's Pallas resilience: a fast-path
-            # failure degrades to the XLA formulation (fused scan for
-            # a fused stream) for the rest of the run instead of
-            # killing the stream
-            if not ran.endswith("pallas"):
-                raise
-            fb = "fused-xla" if ran == "fused-pallas" else "xla"
-            print(
-                "Warning: Pallas kernel failed in the stream path "
-                f"({str(exc)[:120]}); falling back to {fb}"
-            )
-            log_event("stream_pallas_fallback", error=str(exc)[:300])
-            lfp._pallas_ok = False
-            carry.pallas_ok = False  # persists across rounds/restarts
-            eng_req = fb
-            ran = "cascade-xla" if fb == "xla" else fb
-            y, bufs = cascade_decimate_stream(
-                blk, backup, plan, eng_req, mesh=mesh
-            )
-        y = np.asarray(y)
-        t_dev = time.perf_counter() - t0
-        lfp.timings["device_s"] += t_dev
-        _count_block(blk.shape[0], ran, t_dev)
-        carry.bufs = bufs
-        carry.consumed += int(blk.shape[0])
-        s = min(carry.skip_left, y.shape[0])
-        carry.skip_left -= s
-        _emit(lfp, carry, patch, y[s:], rows=int(blk.shape[0]), ran=ran,
-              t_dev=t_dev)
-        off += blk.shape[0]
+            t_disp = time.perf_counter() - t0
+            carry.bufs = bufs
+
+            def _flush(y_dev=y_dev, rows=rows, ran=ran, t_disp=t_disp):
+                t1 = time.perf_counter()
+                y = np.asarray(y_dev)
+                t_dev = t_disp + time.perf_counter() - t1
+                lfp.timings["device_s"] += t_dev
+                _count_block(rows, ran, t_dev)
+                carry.consumed += rows
+                s = min(carry.skip_left, y.shape[0])
+                carry.skip_left -= s
+                _emit(lfp, carry, patch, y[s:], rows=rows, ran=ran,
+                      t_dev=t_dev)
+
+            pipe.push(_flush)
+        off += rows
     carry.residual = np.ascontiguousarray(pool[usable:])
+    carry.residual_scale = pool_qs
 
 
 # FFT stream feed quantum (input samples): block sizes are multiples
@@ -849,14 +1037,15 @@ def _consume_cascade(lfp, carry: StreamCarry, patch, new) -> None:
 _FFT_QUANTUM = 128
 
 
-def _consume_fft(lfp, carry: StreamCarry, patch, new, t_new0_ns) -> None:
+def _consume_fft(lfp, carry: StreamCarry, patch, new, t_new0_ns, qs,
+                 pipe) -> None:
     from tpudas.ops.filter import fft_pass_filter_stream
 
     d = carry.d_ns
     corner = _corner(carry.dt_out)
     mesh = _stream_mesh(lfp)
     q = _FFT_QUANTUM
-    pool = _pool_with_residual(carry, new)
+    pool, pool_qs = _pool_with_residual(carry, new, qs)
     t_pool0_ns = t_new0_ns - (pool.shape[0] - new.shape[0]) * d
     usable = pool.shape[0] - pool.shape[0] % q
     cap_units = max(
@@ -865,53 +1054,63 @@ def _consume_fft(lfp, carry: StreamCarry, patch, new, t_new0_ns) -> None:
     off = 0
     for n_units in _pow2_blocks(usable // q, cap_units):
         blk = pool[off : off + n_units * q]
+        blk_rows = int(blk.shape[0])
+        # dispatch the filter now — the overlap-save carry chains on
+        # DEVICE (bufs[0], sharded under a mesh), so the next block's
+        # dispatch never waits on this block's host sync; the 1-row
+        # lerp seam (bufs[1], host) is updated at flush, strictly
+        # before the next flush reads it (FIFO)
         t0 = time.perf_counter()
-        filt, fcarry = fft_pass_filter_stream(
+        filt_dev, fcarry = fft_pass_filter_stream(
             blk, carry.bufs[0], d / 1e9, high=corner, order=carry.order,
-            mesh=mesh,
+            mesh=mesh, qscale=pool_qs,
         )
-        filt = np.asarray(filt)
-        t_dev = time.perf_counter() - t0
-        lfp.timings["device_s"] += t_dev
-        _count_block(blk.shape[0], "fft", t_dev)
-        tail = carry.bufs[1]
-        rows = (
-            np.concatenate([tail, filt], axis=0) if tail.size else filt
-        )
-        # row j is the filtered stream at the position edge_in samples
-        # behind its input; the stored tail row extends the seam left
-        t_row0 = (
-            t_pool0_ns
-            + off * d
-            - carry.edge_in * d
-            - (tail.shape[0]) * d
-        )
-        t_last = t_row0 + (rows.shape[0] - 1) * d
-        # the overlap-save carry stays a DEVICE array (sharded under a
-        # mesh) and is fed back verbatim next block — it only crosses
-        # to host on the save cadence; the 1-row lerp seam is host data
-        carry.bufs = (fcarry, rows[-1:].copy())
-        carry.consumed += int(blk.shape[0])
-        off += blk.shape[0]
-        if t_last < carry.next_emit_ns or rows.shape[0] < 2:
-            continue
-        n = int((t_last - carry.next_emit_ns) // carry.step_ns) + 1
-        g = carry.next_emit_ns + carry.step_ns * np.arange(
-            n, dtype=np.int64
-        )
-        offs = g - t_row0
-        idx = offs // d
-        w = (offs - idx * d) / float(d)
-        sel = idx >= rows.shape[0] - 1
-        idx[sel] = rows.shape[0] - 2
-        w[sel] = 1.0
-        out = rows[idx] * (1.0 - w[:, None]).astype(np.float32) + rows[
-            idx + 1
-        ] * w[:, None].astype(np.float32)
-        s = min(carry.skip_left, out.shape[0])
-        carry.skip_left -= s
-        _emit(
-            lfp, carry, patch, out[s:].astype(np.float32, copy=False),
-            rows=int(blk.shape[0]), ran="fft", t_dev=t_dev,
-        )
+        t_disp = time.perf_counter() - t0
+        carry.bufs = (fcarry, carry.bufs[1])
+        # row j of the flushed block is the filtered stream at the
+        # position edge_in samples behind its input; the stored tail
+        # row extends the seam left
+        t_blk0 = t_pool0_ns + off * d - carry.edge_in * d
+        off += blk_rows
+
+        def _flush(filt_dev=filt_dev, blk_rows=blk_rows, t_blk0=t_blk0,
+                   t_disp=t_disp):
+            t1 = time.perf_counter()
+            filt = np.asarray(filt_dev)
+            t_dev = t_disp + time.perf_counter() - t1
+            lfp.timings["device_s"] += t_dev
+            _count_block(blk_rows, "fft", t_dev)
+            tail = carry.bufs[1]
+            rows = (
+                np.concatenate([tail, filt], axis=0) if tail.size
+                else filt
+            )
+            t_row0 = t_blk0 - tail.shape[0] * d
+            t_last = t_row0 + (rows.shape[0] - 1) * d
+            carry.bufs = (carry.bufs[0], rows[-1:].copy())
+            carry.consumed += blk_rows
+            if t_last < carry.next_emit_ns or rows.shape[0] < 2:
+                return
+            n = int((t_last - carry.next_emit_ns) // carry.step_ns) + 1
+            g = carry.next_emit_ns + carry.step_ns * np.arange(
+                n, dtype=np.int64
+            )
+            offs = g - t_row0
+            idx = offs // d
+            w = (offs - idx * d) / float(d)
+            sel = idx >= rows.shape[0] - 1
+            idx[sel] = rows.shape[0] - 2
+            w[sel] = 1.0
+            out = rows[idx] * (1.0 - w[:, None]).astype(np.float32) + rows[
+                idx + 1
+            ] * w[:, None].astype(np.float32)
+            s = min(carry.skip_left, out.shape[0])
+            carry.skip_left -= s
+            _emit(
+                lfp, carry, patch, out[s:].astype(np.float32, copy=False),
+                rows=blk_rows, ran="fft", t_dev=t_dev,
+            )
+
+        pipe.push(_flush)
     carry.residual = np.ascontiguousarray(pool[usable:])
+    carry.residual_scale = pool_qs

@@ -42,6 +42,10 @@ block).  Production code marks its fault sites with
 - ``"round.body"`` — top of each realtime processing round
   (tpudas/proc/streaming.py);
 - ``"carry.save"`` — the stream-carry persist (tpudas/proc/stream.py);
+- ``"stream.prefetch"`` — the async-ingest producer, before each
+  speculative slice load (tpudas/proc/ingest.py): a kill here proves
+  a prefetched-but-uncommitted slice is crash-equivalent to
+  never-read;
 - ``"serve.tile_read"`` — per-tile pyramid read (tpudas/serve/tiles.py);
 - ``"serve.queue_full"`` — the HTTP admission gate (tpudas/serve/http.py):
   an injected fault here reads as "gate saturated", so load-shed paths
@@ -394,6 +398,7 @@ FAULT_SITES = (
     "index.update",
     "round.body",
     "carry.save",
+    "stream.prefetch",
     "serve.tile_read",
     "serve.queue_full",
     "integrity.verify",
