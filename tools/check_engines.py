@@ -36,13 +36,20 @@ def accepted_literals() -> dict:
     """The engine literals each dispatch layer accepts, read from the
     dispatch code itself (import, not regex — a rename breaks the
     lint loudly instead of silently narrowing it)."""
-    from tpudas.ops.fir import BATCH_ENGINES, STREAM_ENGINES
+    from tpudas.ops.fir import (
+        BATCH_ENGINES,
+        STACKED_ENGINES,
+        STREAM_ENGINES,
+    )
     from tpudas.proc.lfproc import LFProc
 
     return {
         "LFProc._ENGINES": tuple(LFProc._ENGINES),
         "tpudas.ops.fir.STREAM_ENGINES": tuple(STREAM_ENGINES),
         "tpudas.ops.fir.BATCH_ENGINES": tuple(BATCH_ENGINES),
+        # the ragged-batched fleet path (ISSUE 16): every engine the
+        # stacked dispatch accepts must appear in the test matrix
+        "tpudas.ops.fir.STACKED_ENGINES": tuple(STACKED_ENGINES),
     }
 
 

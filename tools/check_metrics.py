@@ -152,6 +152,15 @@ REQUIRED_METRICS = (
     "tpudas_stream_ingest_misses_total",
     "tpudas_stream_ingest_stall_seconds_total",
     "tpudas_stream_ingest_host_dequant_total",
+    # ragged-batched fleet execution (PR 16): tools/fleet_bench.py's
+    # --batched A/B reads these by name; FLEET.md "Batched scheduling"
+    # and the OBSERVABILITY.md catalog point dashboards at them
+    "tpudas_fleet_batch_groups_total",
+    "tpudas_fleet_batch_members_total",
+    "tpudas_fleet_batch_stacked_launches_total",
+    "tpudas_fleet_batch_stacked_members_total",
+    "tpudas_fleet_batch_solo_launches_total",
+    "tpudas_fleet_batch_sig_memo_total",
 )
 REQUIRED_SPANS = (
     "serve.request",
@@ -178,6 +187,9 @@ REQUIRED_SPANS = (
     "serve.trace",
     "serve.slo",
     "stream.prefetch",
+    # ragged-batched fleet execution (PR 16)
+    "fleet.batch",
+    "op.stacked",
 )
 
 

@@ -81,6 +81,13 @@ and pyramids through one process and one SIGKILL, but each stream
 must crash-resume exactly as if it ran alone.  (``--streams`` and
 ``--mesh`` are mutually exclusive.)
 
+``--batched`` (ISSUE 16) runs the DRILLED fleet cycles with the
+ragged-batched scheduler (``TPUDAS_FLEET_BATCHED=1``: same-plan
+streams stacked into one device program per wave) while the
+single-stream control replay is by construction unbatched — SIGKILLs
+land mid-stacked-launch, proving the batched path's durable bytes
+equal the solo path's.  Requires ``--streams``.
+
 ``--async-ingest`` (ISSUE 15) drills the ASYNC PIPELINED INGEST
 path: every drilled cycle runs with ``TPUDAS_INGEST_PREFETCH=2`` (so
 SIGKILLs land with prefetched-but-uncommitted slices in flight and
@@ -579,6 +586,7 @@ def run_fleet_drill(
     files_init: int = 2,
     files_per_cycle: int = 1,
     log_path: str | None = None,
+    batched: bool = False,
 ) -> dict:
     """The fleet drill (ISSUE 8): SIGKILL a ``streams``-wide
     :class:`tpudas.fleet.FleetEngine` mid-interleave for ``cycles``
@@ -590,11 +598,17 @@ def run_fleet_drill(
     source spools, identical bytes), so ONE single-stream control
     covers all N comparisons; epoch gating holds the feed until a
     cycle runs uninterrupted, exactly as :func:`run_drill` does (and
-    for the same chunk-schedule reason)."""
+    for the same chunk-schedule reason).
+
+    ``batched`` overlays ``TPUDAS_FLEET_BATCHED=1`` on every DRILLED
+    cycle (the ragged-batched scheduler, ISSUE 16); the single-stream
+    control replay never batches, so the comparison pins the batched
+    path's crash-surviving bytes to the solo path's."""
     import numpy as np
 
     from tpudas.integrity.audit import audit_fleet
 
+    drill_env = {"TPUDAS_FLEET_BATCHED": "1"} if batched else None
     streams = int(streams)
     workdir = workdir or tempfile.mkdtemp(
         prefix=f"crash_drill_fleet{streams}_{engine}_"
@@ -613,11 +627,11 @@ def run_fleet_drill(
         epochs = [(0, files_init)]
         feed_all(0, files_init)
         cold = _run_cycle(src_root, out, engine, None, log_fh,
-                          streams=streams)
+                          streams=streams, env_extra=drill_env)
         epochs.append((files_init, files_per_cycle))
         feed_all(files_init, files_per_cycle)
         warm = _run_cycle(src_root, out, engine, None, log_fh,
-                          streams=streams)
+                          streams=streams, env_extra=drill_env)
         est = max(warm["wall"], 0.2)
         rng = np.random.default_rng(seed)
         n_files = files_init + files_per_cycle
@@ -631,14 +645,15 @@ def run_fleet_drill(
                 n_files += files_per_cycle
             kill_after = float(rng.uniform(0.02, est * 0.95))
             r = _run_cycle(src_root, out, engine, kill_after, log_fh,
-                           streams=streams)
+                           streams=streams, env_extra=drill_env)
             kills += int(r["killed"])
             advance = not r["killed"]
             if not r["killed"]:
                 est = max(0.5 * est + 0.5 * r["wall"], 0.2)
             cycle_log.append({"kill_after": round(kill_after, 3), **r})
         # drain, then the whole fleet root must audit clean
-        _run_cycle(src_root, out, engine, None, log_fh, streams=streams)
+        _run_cycle(src_root, out, engine, None, log_fh, streams=streams,
+                   env_extra=drill_env)
         report = audit_fleet(out, repair=True)
         # ONE single-stream control (identical feeds): the plain
         # worker over the same epoch schedule
@@ -669,6 +684,7 @@ def run_fleet_drill(
         return {
             "engine": engine,
             "streams": streams,
+            "batched": bool(batched),
             "cycles": int(cycles),
             "seed": int(seed),
             "kills": kills,
@@ -718,6 +734,17 @@ def main(argv=None) -> int:
         "(ISSUE 11)",
     )
     ap.add_argument(
+        "--batched", action="store_true",
+        help="run the DRILLED fleet cycles under the ragged-batched "
+        "scheduler (TPUDAS_FLEET_BATCHED=1) while the single-stream "
+        "control replay stays solo — SIGKILLs land mid-stacked-launch "
+        "(ISSUE 16); requires --streams",
+    )
+    ap.add_argument(
+        "--workdir", default=None,
+        help="drill scratch directory (default: a fresh mkdtemp)",
+    )
+    ap.add_argument(
         "--async-ingest", action="store_true",
         help="run the DRILLED cycles with async pipelined ingest "
         "(TPUDAS_INGEST_PREFETCH=2) while the control replay stays "
@@ -731,6 +758,9 @@ def main(argv=None) -> int:
                  "combine with --mesh or plain engines")
     if args.streams and args.mesh:
         ap.error("--streams and --mesh are mutually exclusive")
+    if args.batched and not args.streams:
+        ap.error("--batched drills the fleet scheduler; requires "
+                 "--streams")
     if args.codec:
         # workers inherit os.environ (_run_cycle copies it), so one
         # assignment covers every drilled cycle AND the control
@@ -738,14 +768,20 @@ def main(argv=None) -> int:
     results = {}
     ok = True
     for engine in [e for e in args.engines.split(",") if e]:
+        # a shared --workdir gets one subdirectory per engine leg
+        wd = (
+            os.path.join(args.workdir, engine) if args.workdir else None
+        )
         if args.streams:
             print(
                 f"crash_drill: engine={engine} cycles={args.cycles} "
-                f"seed={args.seed} streams={args.streams}"
+                f"seed={args.seed} streams={args.streams} "
+                f"batched={int(args.batched)}"
             )
             rep = run_fleet_drill(
                 engine=engine, streams=args.streams,
                 cycles=args.cycles, seed=args.seed, log_path=args.log,
+                workdir=wd, batched=args.batched,
             )
             results[engine] = rep
             ok = ok and rep["ok"]
@@ -765,7 +801,7 @@ def main(argv=None) -> int:
         rep = run_drill(
             engine=engine, cycles=args.cycles, seed=args.seed,
             log_path=args.log, mesh=args.mesh,
-            async_ingest=args.async_ingest,
+            async_ingest=args.async_ingest, workdir=wd,
         )
         results[engine] = rep
         ok = ok and rep["ok"]
@@ -781,7 +817,7 @@ def main(argv=None) -> int:
         )
     payload = {"cycles": args.cycles, "seed": args.seed,
                "mesh": args.mesh, "streams": args.streams,
-               "codec": args.codec,
+               "batched": args.batched, "codec": args.codec,
                "async_ingest": args.async_ingest, "ok": ok,
                "engines": results}
     if args.out:

@@ -29,6 +29,28 @@ Run (CPU):
 
 Knobs: ``--streams 1,2,4,8``  ``--fs 1000``  ``--channels 256``
 ``--file-sec 10``  ``--drill-cycles 6`` (0 skips the drill).
+
+**Batched A/B (ISSUE 16).**  ``--batched 1`` runs the scale sweep
+(and the byte-identity leg) under the ragged-batched scheduler;
+``--batched ab`` runs every scale point twice — sequential then
+batched, fresh subprocess each — and records the head-to-head
+(aggregate realtime factor, stacked/solo launches per round, lag
+spread).  Both batched modes also run the OPS-LEVEL stacked-vs-
+sequential microbench (``ops_stacked``): N same-plan device steps as
+N solo launches versus ONE stacked launch, the isolated form of the
+launch-overhead claim (the end-to-end fleet on CPU is host-bound —
+spool IO, HDF5 writes, pyramid appends — so the device-step win is
+measured where it lives; PERF.md §13).  The PR 16 artifact:
+
+    JAX_PLATFORMS=cpu python tools/fleet_bench.py \
+        --streams 16,64,256 --batched ab --poll-jitter 0 \
+        --channels 8 --fs 100 --drill-cycles 2 --drill-batched 1 \
+        --out BENCH_pr16.json
+
+(``--poll-jitter 0`` keeps same-config streams due in lockstep so
+batch groups persist past round 1 — the backlog-drain regime batching
+targets; with default jitter, idle-tail polls de-synchronize and
+service solo, by design.)
 """
 
 from __future__ import annotations
@@ -89,14 +111,30 @@ def _install_compile_counter():
     return counts
 
 
-def run_scale_child(n_streams, fs, n_ch, file_sec, feeds=2) -> dict:
+def _metric(name, **labels) -> float:
+    from tpudas.obs.registry import get_registry
+
+    try:
+        return float(get_registry().value(name, **labels))
+    except Exception:
+        return 0.0
+
+
+def run_scale_child(n_streams, fs, n_ch, file_sec, feeds=2,
+                    batched=False, poll_jitter=None) -> dict:
     """One fresh-process scale point: an N-stream fleet, 2 files
-    upfront + ``feeds`` mid-run feeds per stream."""
+    upfront + ``feeds`` mid-run feeds per stream.  ``batched`` runs
+    the ragged-batched scheduler (ISSUE 16) and reads the
+    ``tpudas_fleet_batch_*`` counters back into the report."""
     from tpudas.fleet import FleetEngine, StreamConfig, StreamSpec
 
     compile_counts = _install_compile_counter()
     workdir = tempfile.mkdtemp(prefix=f"fleet_bench_{n_streams}_")
     root = os.path.join(workdir, "root")
+    jitter_kw = (
+        {} if poll_jitter is None
+        else {"poll_jitter": float(poll_jitter)}
+    )
     config = StreamConfig(
         kind="lowpass",
         start_time=T0,
@@ -104,6 +142,7 @@ def run_scale_child(n_streams, fs, n_ch, file_sec, feeds=2) -> dict:
         edge_buffer=EDGE_SEC,
         process_patch_size=PATCH_OUT,
         poll_interval=0.0,
+        **jitter_kw,
     )
     specs = []
     sources = []
@@ -124,10 +163,28 @@ def run_scale_child(n_streams, fs, n_ch, file_sec, feeds=2) -> dict:
             for src in sources:
                 _feed(src, 1 + fed["n"], 1, fs, n_ch, file_sec)
 
-    eng = FleetEngine(root, specs, sleep_fn=feeder)
+    eng = FleetEngine(root, specs, sleep_fn=feeder, batched=batched)
     t0 = time.perf_counter()
     summary = eng.run()
     wall = time.perf_counter() - t0
+    rounds = max(int(summary["rounds_total"]), 1)
+    stacked = _metric("tpudas_fleet_batch_stacked_launches_total")
+    solo = _metric("tpudas_fleet_batch_solo_launches_total")
+    batch_stats = {
+        "enabled": bool(batched),
+        "groups_total": _metric("tpudas_fleet_batch_groups_total"),
+        "members_total": _metric("tpudas_fleet_batch_members_total"),
+        "stacked_launches_total": stacked,
+        "stacked_members_total": _metric(
+            "tpudas_fleet_batch_stacked_members_total"
+        ),
+        "solo_launches_total": solo,
+        "launches_per_round": round((stacked + solo) / rounds, 3),
+        "mean_stack_width": round(
+            _metric("tpudas_fleet_batch_stacked_members_total")
+            / stacked, 2
+        ) if stacked else None,
+    }
     files_total = 2 + feeds
     data_sec_per_stream = files_total * file_sec
     # first PROCESSING step wall per stream, in service order — the
@@ -146,6 +203,8 @@ def run_scale_child(n_streams, fs, n_ch, file_sec, feeds=2) -> dict:
         "streams": n_streams,
         "fs_hz": fs,
         "channels": n_ch,
+        "batched": bool(batched),
+        "batch": batch_stats,
         "data_seconds_per_stream": data_sec_per_stream,
         "rounds_total": summary["rounds_total"],
         "wall_seconds": round(wall, 3),
@@ -189,8 +248,87 @@ def _compile_share(first_walls: dict) -> dict:
     }
 
 
+def bench_ops_stacked(n_list, fs=1000.0, n_ch=8, block_sec=2.0,
+                      repeats=3) -> list:
+    """The launch-overhead claim, isolated (ISSUE 16): N same-plan
+    streams' device steps as N sequential ``cascade_decimate_stream``
+    launches versus ONE ``cascade_decimate_stream_stacked`` launch —
+    identical math, identical bytes (pinned by tier-1), only the
+    launch count differs.  Compile excluded (one warm call per path);
+    best-of-``repeats`` walls, aggregate throughput in processed
+    stream-seconds per wall-second."""
+    import jax
+    import numpy as np
+
+    from tpudas.ops.fir import (
+        cascade_decimate_stream,
+        cascade_decimate_stream_stacked,
+        cascade_stream_init,
+        design_cascade,
+    )
+
+    ratio = int(round(fs * DT_OUT))
+    plan = design_cascade(fs, ratio, 0.45 / DT_OUT, 4)
+    T = int(round(block_sec * fs))
+    rng = np.random.default_rng(0)
+    results = []
+    for n in n_list:
+        blocks = [
+            rng.standard_normal((T, n_ch)).astype(np.float32)
+            for _ in range(n)
+        ]
+        carries = [cascade_stream_init(plan, n_ch) for _ in range(n)]
+
+        def run_seq():
+            return [
+                cascade_decimate_stream(b, c, plan, "xla")
+                for b, c in zip(blocks, carries)
+            ]
+
+        def run_stacked():
+            return cascade_decimate_stream_stacked(
+                blocks, carries, plan, "xla"
+            )
+
+        def timed(fn):
+            best = float("inf")
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                out = fn()
+                jax.block_until_ready([y for y, _c in out])
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        jax.block_until_ready(
+            [y for y, _c in run_seq()] + [y for y, _c in run_stacked()]
+        )  # compile both paths outside the timed region
+        t_seq = timed(run_seq)
+        t_stk = timed(run_stacked)
+        data_sec = n * block_sec
+        entry = {
+            "streams": n,
+            "rows": T,
+            "channels": n_ch,
+            "launches_sequential": n,
+            "launches_stacked": 1,
+            "sequential_wall_s": round(t_seq, 5),
+            "stacked_wall_s": round(t_stk, 5),
+            "speedup": round(t_seq / t_stk, 2),
+            "sequential_aggregate_rt": round(data_sec / t_seq, 1),
+            "stacked_aggregate_rt": round(data_sec / t_stk, 1),
+        }
+        results.append(entry)
+        print(
+            f"fleet_bench: ops_stacked N={n} "
+            f"seq={entry['sequential_wall_s']}s "
+            f"stacked={entry['stacked_wall_s']}s "
+            f"speedup={entry['speedup']}x"
+        )
+    return results
+
+
 def bench_byte_identity(streams=4, fs=200.0, n_ch=16,
-                        file_sec=20.0) -> dict:
+                        file_sec=20.0, batched=False) -> dict:
     """The acceptance criterion, in-process: a fleet of N same-config
     streams (pyramid + detect + health on, identical feeds) versus
     ONE single-stream driver control — outputs, parsed carry, pyramid
@@ -218,6 +356,9 @@ def bench_byte_identity(streams=4, fs=200.0, n_ch=16,
         detect=True,
         detect_operators=DETECT_OPS,
         health=True,
+        # lockstep polling under batched mode so the identity claim
+        # covers rounds that actually ran stacked
+        **({"poll_jitter": 0.0} if batched else {}),
     )
     specs = []
     for i in range(streams):
@@ -236,7 +377,7 @@ def bench_byte_identity(streams=4, fs=200.0, n_ch=16,
             for src in sources:
                 _feed(src, 2, 1, fs, n_ch, file_sec)
 
-    FleetEngine(root, specs, sleep_fn=feeder).run()
+    FleetEngine(root, specs, sleep_fn=feeder, batched=batched).run()
     # one control (identical feeds): the legacy single-stream driver
     ctrl_src = os.path.join(workdir, "ctrl_src")
     ctrl = os.path.join(workdir, "ctrl")
@@ -303,6 +444,7 @@ def bench_byte_identity(streams=4, fs=200.0, n_ch=16,
         )
     return {
         "streams": streams,
+        "batched": bool(batched),
         "per_stream": per_stream,
         "ok": all(s["ok"] for s in per_stream.values()),
     }
@@ -314,8 +456,24 @@ def main(argv=None) -> int:
     ap.add_argument("--fs", type=float, default=1000.0)
     ap.add_argument("--channels", type=int, default=256)
     ap.add_argument("--file-sec", type=float, default=10.0)
+    ap.add_argument(
+        "--batched", default="0", choices=("0", "1", "ab"),
+        help="0: sequential scheduler (PR 8 behavior); 1: ragged-"
+        "batched scheduler; ab: run every scale point BOTH ways and "
+        "record the head-to-head (ISSUE 16)",
+    )
+    ap.add_argument(
+        "--poll-jitter", type=float, default=None,
+        help="per-stream poll jitter fraction for the scale sweep "
+        "(0 keeps same-config streams in lockstep so batch groups "
+        "persist; default: the engine's jitter)",
+    )
     ap.add_argument("--drill-cycles", type=int, default=6)
     ap.add_argument("--drill-streams", type=int, default=4)
+    ap.add_argument(
+        "--drill-batched", type=int, default=0,
+        help="run the fleet crash drill's batched leg (ISSUE 16)",
+    )
     ap.add_argument("--out", default=None)
     ap.add_argument("--child", type=int, default=0,
                     help=argparse.SUPPRESS)
@@ -323,7 +481,9 @@ def main(argv=None) -> int:
 
     if args.child:
         rep = run_scale_child(
-            args.child, args.fs, args.channels, args.file_sec
+            args.child, args.fs, args.channels, args.file_sec,
+            batched=(args.batched == "1"),
+            poll_jitter=args.poll_jitter,
         )
         print("FLEET_CHILD_JSON:" + json.dumps(rep))
         return 0
@@ -332,41 +492,105 @@ def main(argv=None) -> int:
         "bench": "fleet",
         "fs_hz": args.fs,
         "channels": args.channels,
+        "batched_mode": args.batched,
         "scale": [],
     }
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("TPUDAS_COMPILE_CACHE", None)  # cold per child, honestly
-    for n in [int(x) for x in args.streams.split(",") if x]:
-        print(f"fleet_bench: scale N={n} ...")
-        proc = subprocess.run(
-            [
+    n_list = [int(x) for x in args.streams.split(",") if x]
+    legs = {"0": (False,), "1": (True,), "ab": (False, True)}[
+        args.batched
+    ]
+    for n in n_list:
+        for leg_batched in legs:
+            print(
+                f"fleet_bench: scale N={n} "
+                f"batched={int(leg_batched)} ..."
+            )
+            cmd = [
                 sys.executable, os.path.abspath(__file__),
                 "--child", str(n),
                 "--fs", str(args.fs),
                 "--channels", str(args.channels),
                 "--file-sec", str(args.file_sec),
-            ],
-            env=env, capture_output=True, text=True, timeout=3600,
-        )
-        if proc.returncode != 0:
-            print(proc.stdout + proc.stderr)
-            raise RuntimeError(f"scale child N={n} failed")
-        line = [
-            ln for ln in proc.stdout.splitlines()
-            if ln.startswith("FLEET_CHILD_JSON:")
-        ][-1]
-        rep = json.loads(line.split(":", 1)[1])
-        payload["scale"].append(rep)
-        print(
-            f"fleet_bench: N={n} aggregate_rt="
-            f"{rep['aggregate_realtime_factor']} "
-            f"sched_overhead={rep['sched_overhead_pct']}% "
-            f"compile_share={rep['compile_share']}"
+                "--batched", "1" if leg_batched else "0",
+            ]
+            if args.poll_jitter is not None:
+                cmd += ["--poll-jitter", str(args.poll_jitter)]
+            proc = subprocess.run(
+                cmd, env=env, capture_output=True, text=True,
+                timeout=3600,
+            )
+            if proc.returncode != 0:
+                print(proc.stdout + proc.stderr)
+                raise RuntimeError(f"scale child N={n} failed")
+            line = [
+                ln for ln in proc.stdout.splitlines()
+                if ln.startswith("FLEET_CHILD_JSON:")
+            ][-1]
+            rep = json.loads(line.split(":", 1)[1])
+            payload["scale"].append(rep)
+            print(
+                f"fleet_bench: N={n} batched={int(leg_batched)} "
+                f"aggregate_rt={rep['aggregate_realtime_factor']} "
+                f"launches_per_round="
+                f"{rep['batch']['launches_per_round']} "
+                f"sched_overhead={rep['sched_overhead_pct']}% "
+                f"compile_share={rep['compile_share']}"
+            )
+    if args.batched == "ab":
+        # head-to-head per N: sequential vs batched end-to-end walls
+        by_n: dict = {}
+        for rep in payload["scale"]:
+            by_n.setdefault(rep["streams"], {})[
+                "batched" if rep["batched"] else "sequential"
+            ] = rep
+        payload["ab"] = {
+            str(n): {
+                "sequential_rt": v["sequential"][
+                    "aggregate_realtime_factor"
+                ],
+                "batched_rt": v["batched"]["aggregate_realtime_factor"],
+                "end_to_end_speedup": round(
+                    v["batched"]["aggregate_realtime_factor"]
+                    / v["sequential"]["aggregate_realtime_factor"], 2
+                ),
+                "batched_launches_per_round": v["batched"]["batch"][
+                    "launches_per_round"
+                ],
+                "lag_spread_sequential": v["sequential"][
+                    "head_lag_seconds"
+                ]["spread"],
+                "lag_spread_batched": v["batched"]["head_lag_seconds"][
+                    "spread"
+                ],
+            }
+            for n, v in sorted(by_n.items())
+            if "sequential" in v and "batched" in v
+        }
+
+    if args.batched != "0":
+        print("fleet_bench: ops-level stacked vs sequential launches")
+        # headline: the launch-bound regime batching targets (many
+        # small streams — 8 ch, 2 s blocks)
+        payload["ops_stacked"] = bench_ops_stacked(n_list)
+        # the crossover evidence: heavier per-stream work, where the
+        # stacked program's compute dominates and batching stops
+        # paying (PERF.md §13 "when batching loses")
+        print("fleet_bench: ops-level crossover (heavy per-stream work)")
+        payload["ops_stacked_heavy"] = bench_ops_stacked(
+            n_list, n_ch=16, block_sec=4.0
         )
 
-    print("fleet_bench: byte identity (fleet of 4 vs single control)")
-    payload["byte_identity"] = bench_byte_identity()
+    batched_identity = args.batched != "0"
+    print(
+        "fleet_bench: byte identity (fleet of 4 vs single control, "
+        f"batched={int(batched_identity)})"
+    )
+    payload["byte_identity"] = bench_byte_identity(
+        batched=batched_identity
+    )
     print(f"fleet_bench: byte_identity ok={payload['byte_identity']['ok']}")
 
     if args.drill_cycles > 0:
@@ -380,6 +604,7 @@ def main(argv=None) -> int:
         drill = run_fleet_drill(
             engine="cascade", streams=args.drill_streams,
             cycles=args.drill_cycles, seed=0,
+            batched=bool(args.drill_batched),
         )
         drill.pop("cycle_log", None)
         payload["crash_drill_streams"] = drill
@@ -391,8 +616,17 @@ def main(argv=None) -> int:
     sched_ok = all(
         s["sched_overhead_pct"] < 2.0 for s in payload["scale"]
     )
+    # ISSUE 16 acceptance: stacked aggregate throughput >= 3x the
+    # sequential launches at N=64 (the ops-level A/B — same plan,
+    # same blocks, only the launch count differs)
+    stacked_ok = True
+    for entry in payload.get("ops_stacked", []):
+        if entry["streams"] == 64:
+            payload["stacked_3x_at_64"] = bool(entry["speedup"] >= 3.0)
+            stacked_ok = payload["stacked_3x_at_64"]
     payload["ok"] = bool(
         sched_ok
+        and stacked_ok
         and payload["byte_identity"]["ok"]
         and payload.get("crash_drill_streams", {}).get("ok", True)
     )

@@ -441,6 +441,12 @@ class StreamRunner:
         # in-flight round's phase timeline
         self.flight = None
         self._round_phases = None
+        # ragged-batched fleet execution (ISSUE 16): the fleet's group
+        # service installs its BatchStepExecutor here for the duration
+        # of one batched step; _process_round hands it to the per-round
+        # LFProc so the stream step's device dispatches rendezvous.
+        # None (the default) is the ordinary solo dispatch path.
+        self._batch_executor = None
 
     def _init_flight(self, cfg) -> None:
         """Open the on-disk flight recorder beside the carry
@@ -727,6 +733,9 @@ class LowpassStreamRunner(StreamRunner):
             }
         else:
             lfp = LFProc(sub, mesh=self.mesh)
+        # batched fleet service (ISSUE 16): the processor is rebuilt
+        # every round, so the executor handoff is re-installed here
+        lfp._batch_executor = self._batch_executor
         lfp.update_processing_parameter(
             output_sample_interval=self.d_t,
             process_patch_size=self.process_patch_size,

@@ -40,6 +40,20 @@ id) so N co-located streams de-synchronize their spool scans instead
 of thundering-herding the filesystem; a spec's explicit
 ``poll_jitter`` (or ``TPUDAS_POLL_JITTER``) wins.
 
+**Batched scheduling (ISSUE 16).**  With ``batched=True`` (or
+``TPUDAS_FLEET_BATCHED=1``) the scheduler becomes group-by-plan: due
+streams whose memoized batch signature matches
+(:class:`tpudas.fleet.batch.BatchGroupFormer`) are serviced as ONE
+group — one thread per member runs its ordinary ``step()``, and the
+members' device dispatches rendezvous in a
+:class:`tpudas.fleet.batch.BatchStepExecutor` that stacks co-shaped
+blocks into one device program (ragged channel packing; per-stream
+outputs and carries byte-identical to solo execution).  A member that
+faults mid-round drops out of its batch group — not the fleet — with
+its carry sliced back out intact; it parks exactly as in solo
+scheduling.  See FLEET.md "Batched scheduling" for the policy and
+when to leave it off.
+
 See FLEET.md for topology, directory layout, policy, and the runbook.
 """
 
@@ -138,6 +152,15 @@ class FleetEngine:
         terminal.  Successful unparks are counted
         (``tpudas_fleet_unparked_total``) and both transitions leave
         a ``fleet`` park/unpark event in the stream's health.json.
+    batched:
+        Group-by-plan batched scheduling (ISSUE 16): due streams with
+        a matching batch signature are serviced together and their
+        device steps stacked into one launch.  ``None`` (default)
+        reads ``TPUDAS_FLEET_BATCHED`` (off unless ``1``).  Outputs,
+        carries, pyramid, and detect artifacts are byte-identical to
+        unbatched scheduling (tests/test_fleet_batch.py pins it);
+        service ORDER within a round differs (group members run
+        concurrently).
     """
 
     def __init__(
@@ -152,6 +175,7 @@ class FleetEngine:
         on_round=None,
         unpark_probe: float | None = None,
         unpark_max_probes: int = 6,
+        batched: bool | None = None,
     ):
         import os
 
@@ -172,6 +196,15 @@ class FleetEngine:
             None if unpark_probe is None else float(unpark_probe)
         )
         self.unpark_max_probes = int(unpark_max_probes)
+        # ragged-batched scheduling (ISSUE 16): default OFF, opt in per
+        # engine or fleet-wide via env (the crash drill's --batched leg
+        # and the bench A/B flip it this way)
+        if batched is None:
+            batched = os.environ.get("TPUDAS_FLEET_BATCHED", "0") == "1"
+        self.batched = bool(batched)
+        from tpudas.fleet.batch import BatchGroupFormer
+
+        self._former = BatchGroupFormer()
         self._on_round = on_round
         self.now = 0.0  # virtual seconds since run start
         self.sched_seconds = 0.0  # wall spent in scheduler bookkeeping
@@ -269,6 +302,7 @@ class FleetEngine:
         self._state_gauges()
 
     def _park(self, s: _FleetStream, exc: BaseException) -> None:
+        self._former.invalidate(s.stream_id)
         s.status = "parked"
         s.error = f"{type(exc).__name__}: {str(exc)[:300]}"
         s.parked_at = _time.time()
@@ -335,6 +369,7 @@ class FleetEngine:
             )
             return False
         s.runner = runner
+        self._former.invalidate(s.stream_id)
         s.status = "active"
         s.error = None
         s.next_due = self.now
@@ -361,6 +396,125 @@ class FleetEngine:
         )
         self._state_gauges()
         return True
+
+    def _account_step(self, s, res, wall: float, reg) -> None:
+        """Post-step bookkeeping shared by solo and batched service:
+        step counters, service log, terminate/max_rounds transitions,
+        next-due scheduling.  The caller has already charged ``wall``
+        against the stream's deficit."""
+        s.steps += 1
+        s.wall_seconds += wall
+        self.service_log.append((s.stream_id, res.status, wall))
+        reg.counter(
+            "tpudas_fleet_steps_total",
+            "runner steps executed by the fleet scheduler",
+            labelnames=("stream", "status"),
+        ).inc(stream=s.stream_id, status=res.status)
+        reg.histogram(
+            "tpudas_fleet_step_seconds",
+            "wall seconds of one scheduled runner step",
+            labelnames=("stream",),
+        ).observe(wall, stream=s.stream_id)
+        if res.status == "terminate":
+            self._finish_stream(s, "terminated")
+        elif (
+            self.max_rounds is not None
+            and s.runner.polls >= self.max_rounds
+        ):
+            self._finish_stream(s, "max_rounds")
+        else:
+            s.next_due = self.now + res.delay
+
+    def _batch_group(self, s, due):
+        """The batch group for the picked stream: every due stream
+        whose memoized signature matches (ISSUE 16 group-by-plan).
+        ``None`` when the stream must run solo (no signature, or no
+        due peer shares it)."""
+        sig = self._former.signature(s.stream_id, s.runner)
+        if sig is None:
+            return None
+        group = [
+            o for o in due
+            if o is s
+            or self._former.signature(o.stream_id, o.runner) == sig
+        ]
+        return group if len(group) >= 2 else None
+
+    def _service_group(self, group, reg) -> None:
+        """Service one batch group: one thread per member runs its
+        ordinary ``step()`` with the shared
+        :class:`~tpudas.fleet.batch.BatchStepExecutor` installed, so
+        co-shaped device dispatches stack into one launch.  Each
+        member's wall (including rendezvous waits) is charged to its
+        own deficit; park/terminate handling per member is identical
+        to solo service.  ``KeyboardInterrupt``/``SystemExit`` from a
+        member are re-raised after the group joins — the whole-fleet
+        crash model, same as solo scheduling (the other members'
+        completed rounds are already durable; crash-only resume picks
+        them up)."""
+        import threading
+
+        from tpudas.fleet.batch import BatchStepExecutor
+
+        ex = BatchStepExecutor([s.stream_id for s in group])
+        outcomes: dict = {}
+
+        def _run(s):
+            ex.bind(s.stream_id)
+            s.runner._batch_executor = ex
+            t0 = _time.perf_counter()
+            try:
+                with span("fleet.step", stream=s.stream_id):
+                    res = s.runner.step()
+                outcomes[s.stream_id] = (
+                    "ok", res, _time.perf_counter() - t0
+                )
+            except BaseException as exc:
+                outcomes[s.stream_id] = (
+                    "raise", exc, _time.perf_counter() - t0
+                )
+            finally:
+                s.runner._batch_executor = None
+                ex.leave(s.stream_id)
+
+        with span("fleet.batch", streams=len(group)):
+            threads = [
+                threading.Thread(
+                    target=_run, args=(s,),
+                    name=f"fleet-batch-{s.stream_id}", daemon=True,
+                )
+                for s in group
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        reg.counter(
+            "tpudas_fleet_batch_groups_total",
+            "batch groups serviced by the group-by-plan scheduler",
+        ).inc()
+        reg.counter(
+            "tpudas_fleet_batch_members_total",
+            "stream steps serviced inside a batch group",
+        ).inc(len(group))
+        fatal = None
+        for s in group:
+            kind, val, wall = outcomes[s.stream_id]
+            s.deficit -= wall
+            if kind == "raise":
+                s.wall_seconds += wall
+                self.service_log.append((s.stream_id, "fatal", wall))
+                if isinstance(val, Exception):
+                    # a faulted member drops out of its batch group —
+                    # not the fleet; its carry was sliced back out by
+                    # the last completed dispatch
+                    self._park(s, val)
+                elif fatal is None:
+                    fatal = val
+                continue
+            self._account_step(s, val, wall, reg)
+        if fatal is not None:
+            raise fatal
 
     def run(self) -> dict:
         """Serve every stream until it terminates (spool stopped
@@ -403,7 +557,13 @@ class FleetEngine:
                     self.now += max(wait, 0.0)
                     continue
                 s = self._pick(due)
+                group = (
+                    self._batch_group(s, due) if self.batched else None
+                )
                 self.sched_seconds += _time.perf_counter() - t_sched
+                if group is not None:
+                    self._service_group(group, reg)
+                    continue
                 t0 = _time.perf_counter()
                 try:
                     with span("fleet.step", stream=s.stream_id):
@@ -419,28 +579,7 @@ class FleetEngine:
                     continue
                 wall = _time.perf_counter() - t0
                 s.deficit -= wall
-                s.steps += 1
-                s.wall_seconds += wall
-                self.service_log.append((s.stream_id, res.status, wall))
-                reg.counter(
-                    "tpudas_fleet_steps_total",
-                    "runner steps executed by the fleet scheduler",
-                    labelnames=("stream", "status"),
-                ).inc(stream=s.stream_id, status=res.status)
-                reg.histogram(
-                    "tpudas_fleet_step_seconds",
-                    "wall seconds of one scheduled runner step",
-                    labelnames=("stream",),
-                ).observe(wall, stream=s.stream_id)
-                if res.status == "terminate":
-                    self._finish_stream(s, "terminated")
-                elif (
-                    self.max_rounds is not None
-                    and s.runner.polls >= self.max_rounds
-                ):
-                    self._finish_stream(s, "max_rounds")
-                else:
-                    s.next_due = self.now + res.delay
+                self._account_step(s, res, wall, reg)
         wall_total = _time.perf_counter() - t_run0
         reg.counter(
             "tpudas_fleet_sched_seconds_total",

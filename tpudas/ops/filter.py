@@ -34,6 +34,7 @@ __all__ = [
     "fft_pass_filter",
     "fft_stream_init",
     "fft_pass_filter_stream",
+    "fft_pass_filter_stream_stacked",
 ]
 
 
@@ -264,6 +265,126 @@ def fft_pass_filter_stream(block, carry, d_sec, low=None, high=None,
     ):
         out, new_carry = fn(xs, carry, *args)
     return (out[:, :C] if Cp != C else out), new_carry
+
+
+@functools.lru_cache(maxsize=128)
+def _build_stacked_fft_fn(T, rows_carry, widths, d_sec, low, high, order,
+                          mesh, ch_axis, quantized=False):
+    """jit-compiled STACKED FFT stream step (the ragged-batched fleet
+    path, ISSUE 16): N same-parameter streams' overlap-save steps run
+    as ONE device program on the channel-concatenated (T, sum C_i)
+    block.  The filter is column-independent (one rfft/irfft batch per
+    channel, nfft a function of T only), so each member's filtered
+    block and new carry come out byte-identical to its solo
+    :func:`fft_pass_filter_stream` step; members are sliced back out
+    at the static ragged (width, offset) rows.  With ``mesh`` the
+    stacked width is pad-and-masked to the shard multiple inside the
+    program (zeros are inert).  Inputs are donated on accelerator
+    backends, mirroring the solo builder."""
+    edge = rows_carry // 2
+    widths = tuple(int(w) for w in widths)
+    C = sum(widths)
+    offsets = tuple(int(o) for o in np.cumsum((0,) + widths[:-1]))
+
+    def core(block, carry):
+        xc = jnp.concatenate(
+            [carry.astype(jnp.float32), block], axis=0,
+        )
+        filt = fft_pass_filter(xc, d_sec, low=low, high=high, order=order)
+        return filt[edge : edge + T], xc[xc.shape[0] - 2 * edge :]
+
+    body = core
+    Cp = C
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+
+        from tpudas.parallel.compat import shard_map
+
+        Cp = C + (-C % int(mesh.shape[ch_axis]))
+        spec = P(None, ch_axis)
+        body = shard_map(
+            core, mesh=mesh, in_specs=(spec, spec),
+            out_specs=(spec, spec), check_vma=False,
+        )
+    pad = Cp - C
+
+    def fn(blocks, carries, *args):
+        x = jnp.concatenate(list(blocks), axis=1).astype(jnp.float32)
+        if quantized:
+            x = x * args[0]
+        cat = jnp.concatenate(
+            [c.astype(jnp.float32) for c in carries], axis=1
+        )
+        if pad:
+            x = jnp.pad(x, ((0, 0), (0, pad)))
+            cat = jnp.pad(cat, ((0, 0), (0, pad)))
+        filt, new = body(x, cat)
+        outs = tuple(
+            filt[:, o:o + w] for o, w in zip(offsets, widths)
+        )
+        news = tuple(
+            new[:, o:o + w] for o, w in zip(offsets, widths)
+        )
+        return outs, news
+
+    donate = (0, 1) if jax.default_backend() not in ("cpu",) else ()
+    return jax.jit(fn, donate_argnums=donate)
+
+
+def fft_pass_filter_stream_stacked(blocks, carries, d_sec, low=None,
+                                   high=None, order=4, mesh=None,
+                                   ch_axis="ch", qscale=None):
+    """N streams' overlap-save FFT filter steps as ONE stacked device
+    program.  ``blocks`` share T and the filter parameters; each keeps
+    its own channel width (ragged packing).  Returns
+    ``[(filtered_i, new_carry_i), ...]`` in member order,
+    byte-identical per member to :func:`fft_pass_filter_stream` (the
+    filter is column-independent).  ``qscale`` is one traced scalar
+    shared by every member — the fleet group former keys on the value,
+    so mixed-scale streams are never stacked.  Blocks and previous
+    carries are donated on accelerator backends — do not reuse."""
+    from tpudas.ops.fir import _check_quantized
+
+    blocks = tuple(blocks)
+    carries = tuple(carries)
+    if not blocks or len(blocks) != len(carries):
+        raise ValueError(
+            f"blocks/carries length mismatch: {len(blocks)} vs "
+            f"{len(carries)}"
+        )
+    T = int(np.shape(blocks[0])[0])
+    rows_carry = int(np.shape(carries[0])[0])
+    if rows_carry % 2:
+        raise ValueError(
+            f"carry must be (2*edge, C), got {tuple(np.shape(carries[0]))}"
+        )
+    for i, (b, c) in enumerate(zip(blocks, carries)):
+        if int(np.shape(b)[0]) != T or int(np.shape(c)[0]) != rows_carry:
+            raise ValueError(
+                f"member {i} shapes {tuple(np.shape(b))}/"
+                f"{tuple(np.shape(c))} do not match the wave's "
+                f"T={T}, 2*edge={rows_carry}"
+            )
+        if int(np.shape(b)[1]) != int(np.shape(c)[1]):
+            raise ValueError(
+                f"member {i} block {tuple(np.shape(b))} does not match "
+                f"carry {tuple(np.shape(c))}"
+            )
+        _check_quantized(b, qscale)
+    quantized = qscale is not None
+    widths = tuple(int(np.shape(b)[1]) for b in blocks)
+    fn = _build_stacked_fft_fn(
+        T, rows_carry, widths, float(d_sec), low, high, int(order),
+        mesh, ch_axis, quantized=quantized,
+    )
+    from tpudas.obs.trace import span
+
+    args = (jnp.float32(qscale),) if quantized else ()
+    with span(
+        "op.stacked", rows=T, streams=len(blocks), edge=rows_carry // 2,
+    ):
+        outs, news = fn(blocks, carries, *args)
+    return list(zip(outs, news))
 
 
 def _host_sosfiltfilt(data, d_sec, low, high, order):

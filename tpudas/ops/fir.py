@@ -67,6 +67,8 @@ __all__ = [
     "fused_intermediate_bytes",
     "STREAM_ENGINES",
     "BATCH_ENGINES",
+    "STACKED_ENGINES",
+    "cascade_decimate_stream_stacked",
 ]
 
 # engine literals the STREAM dispatch (cascade_decimate_stream)
@@ -82,6 +84,16 @@ STREAM_ENGINES = ("auto", "pallas", "xla", "fused", "fused-xla",
 # streaming-only (it exists to kill per-stage intermediates ACROSS
 # carried blocks; the batch path's windows are one-shot).
 BATCH_ENGINES = ("auto", "pallas", "xla")
+# engine literals the STACKED multi-stream entry point
+# (cascade_decimate_stream_stacked) accepts: RESOLVED non-Pallas
+# stream variants only.  The fleet's batch executor routes a block
+# here only after the per-stream solo resolution already chose one of
+# these, so stacking can never flip a stream across the
+# fused_min_elems threshold (the stacked width is larger than any
+# member's solo width) and never silently swaps a tolerance-based
+# Pallas variant for the exact XLA one.  tools/check_engines.py lints
+# that every literal here appears in the test matrix.
+STACKED_ENGINES = ("xla", "fused-xla")
 
 # every env knob that changes kernel geometry or engine selection.
 # knob_fingerprint() reads them at CALL time and every jit/layout
@@ -561,6 +573,7 @@ def _clear_cascade_caches():
     _build_cascade_fn.cache_clear()
     _build_stream_cascade_fn.cache_clear()
     _build_fused_stream_fn.cache_clear()
+    _build_stacked_stream_fn.cache_clear()
     _layout_for.cache_clear()
     try:
         from tpudas.parallel.pipeline import _build_sharded_cascade_fn
@@ -1230,6 +1243,220 @@ def cascade_decimate_stream(x, carry, plan: CascadePlan, engine="auto",
     if fused:
         _count_fused(plan, T, C, engine)
     return (y[:, :C] if Cp != C else y), bufs
+
+
+# ---------------------------------------------------------------------------
+# ragged-stacked streaming (ISSUE 16): N same-plan streams as ONE
+# device program.
+#
+# Every stage of the cascade is channel-column independent (the
+# property the PR 7 pad-and-mask layout already relies on), so N
+# streams' (T, C_i) blocks concatenated along the channel axis run the
+# SAME per-stage arithmetic in one launch and each stream's columns
+# come out byte-identical to its solo step.  The ragged packing is the
+# static (width, offset) row list: offsets are cumulative widths, the
+# split slices are compiled into the program, and each stream's carry
+# leaves are sliced back out as separate device arrays — a member
+# leaving its batch group keeps a carry indistinguishable from solo
+# execution.  With a mesh the stacked width is pad-and-masked to the
+# shard multiple INSIDE the program (zeros are inert, exactly as in
+# tpudas.parallel.sharding), so a 2-D stream x channel layout composes
+# with the PR 7 mesh.
+
+
+@functools.lru_cache(maxsize=128)
+def _build_stacked_stream_fn(plan: CascadePlan, T: int, widths: tuple,
+                             engine: str, mesh=None, ch_axis="ch",
+                             knobs=(), quantized=False):
+    """jit-compiled STACKED stateful step: (N blocks (T, C_i), N
+    carries) -> (N outputs (T/ratio, C_i), N new carries), all inside
+    one device program.  ``engine`` is a resolved
+    :data:`STACKED_ENGINES` literal: ``xla`` replays the per-stage
+    chain of :func:`_build_stream_cascade_fn`, ``fused-xla`` the
+    chunked ``lax.scan`` of :func:`_build_fused_stream_fn` — both on
+    the concatenated (T, sum C_i) block, so per-stream outputs AND
+    carry leaves are byte-identical to the solo step (channel columns
+    are independent).  ``quantized`` takes a traced ``qscale`` scalar
+    shared by every member (the batch group former keys on it).
+    Inputs are donated on accelerator backends, mirroring the solo
+    builders."""
+    import jax
+    import jax.numpy as jnp
+
+    blocked = _blocked_taps(plan)
+    sizes = stream_carry_sizes(plan)
+    widths = tuple(int(w) for w in widths)
+    C = sum(widths)
+    offsets = tuple(int(o) for o in np.cumsum((0,) + widths[:-1]))
+
+    if engine == "fused-xla":
+        n_out_total = T // plan.ratio
+        chunk_out = fused_chunk_outputs(plan, n_out_total)
+        chunk_in = chunk_out * plan.ratio
+        n_steps = n_out_total // chunk_out
+
+        def step(bufs, xc):
+            y = xc
+            new = []
+            for (R, hb), p, buf in zip(blocked, sizes, bufs):
+                xi = jnp.concatenate([buf, y], axis=0) if p else y
+                k = y.shape[0] // R
+                new.append(xi[xi.shape[0] - p:])
+                y = _polyphase_stage_xla(xi, hb, R, k)
+            return tuple(new), y
+
+        def core(x, carry):
+            if n_steps <= 1:
+                bufs, y = step(tuple(carry), x)
+                return y, bufs
+            xs = x.reshape(n_steps, chunk_in, x.shape[1])
+            bufs, ys = jax.lax.scan(step, tuple(carry), xs)
+            return ys.reshape(n_out_total, x.shape[1]), bufs
+
+    else:
+
+        def core(x, carry):
+            new_carry = []
+            for (R, hb), p, buf in zip(blocked, sizes, carry):
+                xc = jnp.concatenate([buf, x], axis=0) if p else x
+                k = x.shape[0] // R
+                y = _polyphase_stage_xla(xc, hb, R, k)
+                new_carry.append(xc[xc.shape[0] - p:])
+                x = y
+            return x, tuple(new_carry)
+
+    body = core
+    Cp = C
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+
+        from tpudas.parallel.compat import shard_map
+
+        Cp = C + (-C % int(mesh.shape[ch_axis]))
+        spec = P(None, ch_axis)
+        carry_specs = tuple(spec for _ in sizes)
+        body = shard_map(
+            core,
+            mesh=mesh,
+            in_specs=(spec, carry_specs),
+            out_specs=(spec, carry_specs),
+            check_vma=False,
+        )
+    pad = Cp - C
+
+    def fn(xs, carries, *args):
+        # ragged channel packing: concatenate member columns at the
+        # static offsets, run one program, slice members back out
+        x = jnp.concatenate(list(xs), axis=1).astype(jnp.float32)
+        if quantized:
+            x = x * args[0]
+        cat = tuple(
+            jnp.concatenate(
+                [c[i].astype(jnp.float32) for c in carries], axis=1
+            )
+            for i in range(len(sizes))
+        )
+        if pad:
+            x = jnp.pad(x, ((0, 0), (0, pad)))
+            cat = tuple(jnp.pad(b, ((0, 0), (0, pad))) for b in cat)
+        y, new = body(x, cat)
+        outs = tuple(
+            y[:, o:o + w] for o, w in zip(offsets, widths)
+        )
+        new_carries = tuple(
+            tuple(leaf[:, o:o + w] for leaf in new)
+            for o, w in zip(offsets, widths)
+        )
+        return outs, new_carries
+
+    donate = (0, 1) if jax.default_backend() not in ("cpu",) else ()
+    return jax.jit(fn, donate_argnums=donate)
+
+
+def cascade_decimate_stream_stacked(blocks, carries, plan: CascadePlan,
+                                    engine="xla", mesh=None,
+                                    ch_axis="ch", qscale=None):
+    """N same-plan streams' stateful steps as ONE stacked device
+    program (the ragged-batched fleet path, ISSUE 16).
+
+    ``blocks`` is a sequence of (T, C_i) blocks sharing T (mixed
+    channel widths are the ragged case — each stream keeps its own
+    width); ``carries`` the matching per-stream carry pytrees (from
+    :func:`cascade_stream_init` or previous solo/stacked steps — the
+    layouts are identical, so a stream moves freely between solo and
+    stacked execution).  Returns ``[(y_i, new_carry_i), ...]`` in
+    member order; every output and carry leaf is byte-identical to
+    what ``cascade_decimate_stream`` returns for that member alone
+    (channel-column independence — the same property that makes the
+    PR 7 sharded step byte-identical).
+
+    ``engine`` must be a RESOLVED :data:`STACKED_ENGINES` literal —
+    callers resolve per member at the member's own solo width first
+    (see tpudas.fleet.batch), so stacking never changes an engine
+    decision.  ``qscale`` is a single traced scalar shared by every
+    member: mixed-scale streams must not be stacked together (the
+    group former keys on the scale value).  Neither the blocks nor
+    the previous carries may be reused after the call (donated on
+    accelerator backends)."""
+    import jax.numpy as jnp
+
+    if engine not in STACKED_ENGINES:
+        raise ValueError(
+            f"stacked engine must be one of {STACKED_ENGINES}, got "
+            f"{engine!r}"
+        )
+    blocks = tuple(blocks)
+    carries = tuple(tuple(c) for c in carries)
+    if not blocks or len(blocks) != len(carries):
+        raise ValueError(
+            f"blocks/carries length mismatch: {len(blocks)} vs "
+            f"{len(carries)}"
+        )
+    T = int(np.shape(blocks[0])[0])
+    if T % plan.ratio:
+        raise ValueError(
+            f"stream block length {T} is not a multiple of the "
+            f"decimation ratio {plan.ratio}"
+        )
+    widths = tuple(int(np.shape(b)[1]) for b in blocks)
+    sizes = stream_carry_sizes(plan)
+    for i, (b, c, w) in enumerate(zip(blocks, carries, widths)):
+        if int(np.shape(b)[0]) != T:
+            raise ValueError(
+                f"member {i} block has {int(np.shape(b)[0])} rows; the "
+                f"stacked step needs a shared T={T} (partition waves "
+                "by block length)"
+            )
+        if len(c) != len(sizes) or any(
+            int(np.shape(leaf)[0]) != p for leaf, p in zip(c, sizes)
+        ):
+            raise ValueError(
+                f"member {i} carry does not match this plan's "
+                "stream_carry_sizes "
+                f"({[int(np.shape(leaf)[0]) for leaf in c]} vs "
+                f"{list(sizes)})"
+            )
+        if any(int(np.shape(leaf)[1]) != w for leaf, _p in zip(c, sizes)):
+            raise ValueError(
+                f"member {i} carry width "
+                f"{[np.shape(leaf) for leaf in c]} does not match its "
+                f"block width {w}"
+            )
+        _check_quantized(b, qscale)
+    quantized = qscale is not None
+    fn = _build_stacked_stream_fn(
+        plan, T, widths, engine, mesh, ch_axis,
+        knobs=knob_fingerprint(), quantized=quantized,
+    )
+    from tpudas.obs.trace import span
+
+    args = (jnp.float32(qscale),) if quantized else ()
+    with span("op.stacked", rows=T, streams=len(blocks), engine=engine):
+        outs, news = fn(blocks, carries, *args)
+    if engine == "fused-xla":
+        for w in widths:
+            _count_fused(plan, T, w, engine)
+    return list(zip(outs, news))
 
 
 # ---------------------------------------------------------------------------

@@ -1005,10 +1005,24 @@ def _consume_cascade(lfp, carry: StreamCarry, patch, new, qs,
             # sync + emit when the block reaches the pipeline head —
             # same order, same math, just overlapped wall clock
             t0 = time.perf_counter()
-            y_dev, bufs = cascade_decimate_stream(
-                blk, carry.bufs, plan, eng_req, mesh=mesh,
-                qscale=pool_qs,
-            )
+            bx = getattr(lfp, "_batch_executor", None)
+            if bx is not None and mesh is None:
+                # ragged-batched fleet service (ISSUE 16): rendezvous
+                # with the other batch-group members so co-shaped
+                # blocks stack into ONE device program.  The engine is
+                # resolved HERE at this stream's own width (`ran` is
+                # already the solo decision), so stacking never flips
+                # a threshold; byte-identical either way.
+                y_dev, bufs = bx.cascade_step(
+                    blk, carry.bufs, plan,
+                    ran if ran == "fused-xla" else "xla",
+                    qscale=pool_qs,
+                )
+            else:
+                y_dev, bufs = cascade_decimate_stream(
+                    blk, carry.bufs, plan, eng_req, mesh=mesh,
+                    qscale=pool_qs,
+                )
             t_disp = time.perf_counter() - t0
             carry.bufs = bufs
 
@@ -1061,10 +1075,20 @@ def _consume_fft(lfp, carry: StreamCarry, patch, new, t_new0_ns, qs,
         # lerp seam (bufs[1], host) is updated at flush, strictly
         # before the next flush reads it (FIFO)
         t0 = time.perf_counter()
-        filt_dev, fcarry = fft_pass_filter_stream(
-            blk, carry.bufs[0], d / 1e9, high=corner, order=carry.order,
-            mesh=mesh, qscale=pool_qs,
-        )
+        bx = getattr(lfp, "_batch_executor", None)
+        if bx is not None and mesh is None:
+            # ragged-batched fleet service (ISSUE 16): stack with the
+            # batch group's co-parameter FFT blocks (same T, edge,
+            # corner, order, dtype, qscale — the executor's wave key)
+            filt_dev, fcarry = bx.fft_step(
+                blk, carry.bufs[0], d / 1e9, corner, carry.order,
+                qscale=pool_qs,
+            )
+        else:
+            filt_dev, fcarry = fft_pass_filter_stream(
+                blk, carry.bufs[0], d / 1e9, high=corner,
+                order=carry.order, mesh=mesh, qscale=pool_qs,
+            )
         t_disp = time.perf_counter() - t0
         carry.bufs = (fcarry, carry.bufs[1])
         # row j of the flushed block is the filtered stream at the
