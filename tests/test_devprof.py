@@ -1,0 +1,359 @@
+"""Device telemetry plane (ISSUE 17): ``tpudas.obs.devprof``.
+
+Pins the accounting semantics the bench and the operator runbook
+lean on: cold vs warm builder-key counters, recompile attribution by
+what changed (shape vs knob fingerprint), stacked 1/N vs solo launch
+attribution, the compile-seconds exclusion from device-execute
+brackets, the per-round delta collection, the flight-record
+``devprof`` roundtrip through :func:`tpudas.obs.collect.devprof_entry`,
+the ``GET /devprof`` / ``GET /profile`` control-plane endpoints
+(profiler-unavailable = 501, never a crash; ENOSPC shed parity with
+every other non-essential writer), and the BENCH-trajectory gate in
+``tools/bench_history.py``.
+"""
+
+import importlib.util
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpudas.integrity import resource
+from tpudas.obs import devprof
+from tpudas.obs.collect import devprof_entry
+from tpudas.obs.flight import FlightRecorder, read_flight
+from tpudas.obs.registry import MetricsRegistry, use_registry
+from tpudas.serve.http import start_server
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def fresh_devprof():
+    devprof.reset()
+    yield
+    devprof.reset()
+
+
+def _compile_event(secs=0.25):
+    """Simulate the jax monitoring hook firing for a backend compile
+    (the real listener keys on this suffix)."""
+    devprof._on_compile_duration(
+        "/jax/core/compile/backend_compile_duration", secs
+    )
+
+
+class TestCompileAttribution:
+    def test_cold_then_warm_key(self):
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            devprof.note_kernel("cascade", (64, 2000), ("xla",))
+            _compile_event(0.5)
+            # warm: the exact key again — no trigger, and a stray
+            # compile on this thread reads unattributed
+            devprof.note_kernel("cascade", (64, 2000), ("xla",))
+            _compile_event(0.125)
+            snap = devprof.devprof_snapshot(calibrate=False)
+        by_trigger = snap["compile"]["by_trigger"]
+        assert by_trigger.get("first") == 1
+        assert by_trigger.get("unattributed") == 1
+        assert snap["compile"]["count"] == 2
+        assert snap["compile"]["seconds"] == pytest.approx(0.625)
+        assert reg.value(
+            "tpudas_devprof_compiles_total", trigger="first"
+        ) == 1.0
+        assert reg.value(
+            "tpudas_devprof_compile_seconds_total"
+        ) == pytest.approx(0.625)
+
+    def test_shape_vs_knob_fingerprint(self):
+        with use_registry(MetricsRegistry()):
+            devprof.note_kernel("fused", (64, 2000), ("knobA",))
+            _compile_event()
+            # same knobs, new geometry -> shape
+            devprof.note_kernel("fused", (128, 2000), ("knobA",))
+            _compile_event()
+            # same geometry, the env fingerprint moved -> knobs
+            devprof.note_kernel("fused", (128, 2000), ("knobB",))
+            _compile_event()
+            snap = devprof.devprof_snapshot(calibrate=False)
+        assert snap["compile"]["by_trigger"] == {
+            "first": 1, "shape": 1, "knobs": 1
+        }
+        kinds = [k["trigger"] for k in snap["compile"]["kernels"]]
+        assert kinds == ["first", "shape", "knobs"]
+
+    def test_cold_starts_never_storm(self, monkeypatch):
+        """A fleet cold start compiles every kernel once — 'first'
+        triggers must not trip the recompile-storm alarm."""
+        monkeypatch.setenv("TPUDAS_DEVPROF_STORM", "3/60")
+        with use_registry(MetricsRegistry()):
+            for i in range(6):
+                devprof.note_kernel("k%d" % i, (8,), ("x",))
+                _compile_event(0.01)
+            snap = devprof.devprof_snapshot(calibrate=False)
+            assert snap["compile"]["storms"] == 0
+            assert snap["compile"]["storm_active"] is False
+            # but genuine shape churn on one kernel does storm
+            for i in range(4):
+                devprof.note_kernel("churn", (8 + i,), ("x",))
+                _compile_event(0.01)
+            snap = devprof.devprof_snapshot(calibrate=False)
+        assert snap["compile"]["storms"] == 1
+        assert snap["compile"]["storm_active"] is True
+
+    def test_compile_excluded_from_device_seconds(self):
+        """A cold key's synchronous compile lands in compile
+        accounting, never in the launch bracket's device seconds."""
+        with use_registry(MetricsRegistry()):
+            with devprof.stream_scope("s0"):
+                devprof.note_kernel("k", (8,), ("x",))
+                t0 = time.perf_counter()
+                _compile_event(3600.0)  # absurd compile inside bracket
+                devprof.note_launch("xla", t0, out=None)
+            stats = devprof.classify_stream("s0", calibrate=False)
+        assert stats["launches"] == 1.0
+        # the 3600 s never reached device_s: bracket clamped to ~0
+        assert stats["device_seconds"] < 1.0
+
+
+class TestLaunchAttribution:
+    def test_solo_vs_stacked_keys(self):
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            with devprof.stream_scope("solo"):
+                devprof.note_launch("xla", time.perf_counter(), None)
+            with devprof.wave_scope(["a", "b", "c", "d"]):
+                devprof.note_launch(
+                    "xla", time.perf_counter(), None, stacked=True
+                )
+            snap = devprof.devprof_snapshot(calibrate=False)
+        assert reg.value(
+            "tpudas_devprof_launches_total",
+            engine="xla", stacked="0", stream="solo",
+        ) == 1.0
+        # 1/N per member: the sum over members is ONE launch
+        for m in ("a", "b", "c", "d"):
+            assert reg.value(
+                "tpudas_devprof_launches_total",
+                engine="xla", stacked="1", stream=m,
+            ) == pytest.approx(0.25)
+        keys = {(e["engine"], e["stacked"], e["stream"])
+                for e in snap["launches"]}
+        assert ("xla", "0", "solo") in keys
+        assert ("xla", "1", "a") in keys
+
+    def test_round_collect_deltas(self):
+        with use_registry(MetricsRegistry()):
+            with devprof.stream_scope("s1"):
+                for _ in range(3):
+                    devprof.note_launch(
+                        "xla", time.perf_counter(), None
+                    )
+                d1 = devprof.round_collect()
+                d2 = devprof.round_collect()
+        assert d1["launches"] == 3.0
+        assert d1["device_execute_s"] >= 0.0
+        assert "utilization" in d1 and "bound" in d1
+        # second boundary with no new launches: zero delta
+        assert d2["launches"] == 0.0
+        assert d2["device_execute_s"] == 0.0
+
+    def test_classification_thresholds(self, monkeypatch):
+        """Utilization-first verdict; launch-floor ratio only as the
+        no-cost-data fallback."""
+        monkeypatch.setenv("TPUDAS_DEVPROF_PEAK_FLOPS", "1e9")
+        monkeypatch.setenv("TPUDAS_DEVPROF_PEAK_BYTES", "1e9")
+        with use_registry(MetricsRegistry()):
+            with devprof.stream_scope("hot"):
+                # 1 s of device time explained by 0.9e9 flops at a
+                # 1e9 flops/s peak -> utilization 0.9 -> compute_bound
+                devprof.note_launch(
+                    "xla", time.perf_counter() - 1.0, None,
+                    cost={"flops": 0.9e9, "bytes": 0.0},
+                )
+            with devprof.stream_scope("idle"):
+                # same wall, trivial kernel -> utilization ~0
+                devprof.note_launch(
+                    "xla", time.perf_counter() - 1.0, None,
+                    cost={"flops": 1e3, "bytes": 1e3},
+                )
+            hot = devprof.classify_stream("hot")
+            idle = devprof.classify_stream("idle")
+        assert hot["bound"] == "compute_bound"
+        assert hot["utilization"] == pytest.approx(0.9, abs=0.05)
+        assert idle["bound"] == "launch_bound"
+        assert idle["utilization"] < 0.01
+
+    def test_disabled_is_total_noop(self, monkeypatch):
+        monkeypatch.setenv("TPUDAS_DEVPROF", "0")
+        with use_registry(MetricsRegistry()):
+            with devprof.stream_scope("off"):
+                devprof.note_kernel("k", (8,), ("x",))
+                devprof.note_launch("xla", time.perf_counter(), None)
+                assert devprof.round_collect() == {}
+            stats = devprof.classify_stream("off", calibrate=False)
+        assert stats["launches"] == 0.0
+
+
+class TestFlightRoundtrip:
+    def test_round_record_carries_devprof(self, tmp_path):
+        folder = str(tmp_path)
+        with use_registry(MetricsRegistry()):
+            rec = FlightRecorder(folder)
+            for i in range(4):
+                rec.record(
+                    "round", stream="s", round=i,
+                    phases={"device_execute": 0.004, "host_wait": 0.006,
+                            "read_decode": 0.01},
+                    realtime_factor=100.0, head_lag=1.0,
+                    devprof={"launches": 2.0,
+                             "device_execute_s": 0.004,
+                             "bound": "launch_bound",
+                             "utilization": 0.3},
+                )
+            rec.flush()
+        rounds = read_flight(folder, kind="round")
+        assert len(rounds) == 4
+        assert rounds[-1]["devprof"]["bound"] == "launch_bound"
+        entry = devprof_entry(rounds)
+        assert entry["rounds"] == 4
+        assert entry["launches_per_round"] == pytest.approx(2.0)
+        assert entry["device_execute_s"] == pytest.approx(0.016)
+        assert entry["bound"] == "launch_bound"
+        assert entry["utilization"] == pytest.approx(0.3)
+        # device-busy fraction = device seconds / phase wall
+        assert 0.0 < entry["device_busy_fraction"] <= 1.0
+
+    def test_entry_none_without_devprof_records(self):
+        assert devprof_entry([]) is None
+        assert devprof_entry([{"kind": "round", "phases": {}}]) is None
+
+
+class TestEndpoints:
+    def test_devprof_endpoint(self, tmp_path):
+        with use_registry(MetricsRegistry()):
+            with devprof.stream_scope("web"):
+                devprof.note_launch("xla", time.perf_counter(), None)
+            with start_server(str(tmp_path)) as srv:
+                r = urllib.request.urlopen(
+                    srv.base_url + "/devprof?calibrate=0", timeout=10
+                )
+                doc = json.loads(r.read())
+        assert r.status == 200
+        assert doc["enabled"] is True
+        assert "web" in doc["streams"]
+        assert doc["streams"]["web"]["launches"] == 1.0
+        assert set(doc["calibration"]) >= {
+            "launch_floor_s", "util_bound_threshold",
+            "launch_ratio_threshold",
+        }
+
+    def test_profile_status_bare(self, tmp_path):
+        with use_registry(MetricsRegistry()):
+            with start_server(str(tmp_path)) as srv:
+                r = urllib.request.urlopen(
+                    srv.base_url + "/profile", timeout=10
+                )
+                assert r.status == 200
+                assert json.loads(r.read()) is None
+
+    def test_profile_unavailable_is_501(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(
+            devprof, "profiler_available", lambda: False
+        )
+        with use_registry(MetricsRegistry()):
+            with start_server(str(tmp_path)) as srv:
+                with pytest.raises(urllib.error.HTTPError) as exc:
+                    urllib.request.urlopen(
+                        srv.base_url + "/profile?seconds=1", timeout=10
+                    )
+        assert exc.value.code == 501
+        assert "profiler" in json.loads(exc.value.read())["error"]
+
+    def test_profile_bad_seconds_is_400(self, tmp_path):
+        with use_registry(MetricsRegistry()):
+            with start_server(str(tmp_path)) as srv:
+                with pytest.raises(urllib.error.HTTPError) as exc:
+                    urllib.request.urlopen(
+                        srv.base_url + "/profile?seconds=nope",
+                        timeout=10,
+                    )
+        assert exc.value.code == 400
+
+
+class TestProfileShedParity:
+    def test_enospc_sheds_profile(self, tmp_path, monkeypatch):
+        """A deep capture is a non-essential writer: under disk
+        pressure it sheds exactly like the pyramid/prom writers."""
+        monkeypatch.setenv("TPUDAS_PROFILE_DIR", str(tmp_path))
+        with use_registry(MetricsRegistry()):
+            resource.note_pressure("test", None)
+            try:
+                assert resource.is_degraded()
+                with pytest.raises(RuntimeError, match="shed"):
+                    devprof.start_profile(1.0)
+            finally:
+                resource.clear_pressure("test done")
+
+    def test_bad_duration_and_missing_dir(self, monkeypatch):
+        monkeypatch.delenv("TPUDAS_PROFILE_DIR", raising=False)
+        monkeypatch.delenv("TPUDAS_TRACE_DIR", raising=False)
+        with pytest.raises(ValueError, match="seconds"):
+            devprof.start_profile(-1.0)
+        with pytest.raises(ValueError, match="directory"):
+            devprof.start_profile(1.0)
+
+
+def _load_bench_history():
+    spec = importlib.util.spec_from_file_location(
+        "bench_history", os.path.join(REPO, "tools", "bench_history.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestBenchHistoryGate:
+    def test_gate_passes_and_fails(self, tmp_path):
+        bh = _load_bench_history()
+        old = {"bench": {"speedup": 4.0, "overhead_pct": 0.5,
+                         "rounds": 8}}
+        (tmp_path / "BENCH_pr90.json").write_text(json.dumps(old))
+        # regression: speedup down 50%, overhead up 4x
+        bad = {"bench": {"speedup": 2.0, "overhead_pct": 2.0,
+                         "rounds": 8}}
+        (tmp_path / "BENCH_pr91.json").write_text(json.dumps(bad))
+        cmp_bad = bh.compare_headlines(bad, old, tolerance=0.15)
+        assert not cmp_bad["passed"]
+        regressed = {r["path"] for r in cmp_bad["regressions"]}
+        assert "bench.speedup" in regressed
+        assert "bench.overhead_pct" in regressed
+        # structural numerics (rounds) are never compared
+        assert not any("rounds" in k for k in regressed)
+        # within tolerance: passes
+        ok = {"bench": {"speedup": 3.8, "overhead_pct": 0.55,
+                        "rounds": 8}}
+        assert bh.compare_headlines(ok, old, tolerance=0.15)["passed"]
+
+    def test_gate_cli(self, tmp_path):
+        import subprocess
+        import sys
+
+        old = {"x": {"speedup": 4.0}}
+        new = {"x": {"speedup": 4.2}}
+        p_old = tmp_path / "BENCH_pr90.json"
+        p_new = tmp_path / "BENCH_pr91.json"
+        p_old.write_text(json.dumps(old))
+        p_new.write_text(json.dumps(new))
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "bench_history.py"),
+             "--root", str(tmp_path), "--gate", str(p_new)],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, proc.stderr + proc.stdout
+        assert "PASS" in proc.stdout

@@ -325,11 +325,11 @@ class TestPhases:
         ph = RoundPhases()
         with ph.measure("poll"):
             pass
-        ph.add("compute", 0.25)
-        ph.add("compute", 0.25)
+        ph.add("host_wait", 0.25)
+        ph.add("host_wait", 0.25)
         out = ph.finish(reg)
         assert sorted(out) == sorted(PHASES)
-        assert out["compute"] == 0.5
+        assert out["host_wait"] == 0.5
         snap = phase_seconds_snapshot(reg)
         assert set(snap) == set(PHASES)  # every phase observed once
         for p in PHASES:
@@ -357,7 +357,11 @@ class TestPhases:
         assert [r["round"] for r in recs] == list(range(1, rounds + 1))
         for r in recs:
             assert sorted(r["phases"]) == sorted(PHASES)
-            assert r["phases"]["compute"] > 0.0
+            # the former "compute" phase is now split (ISSUE 17):
+            # device_execute + host_wait together carry the round's
+            # processing residual
+            assert (r["phases"]["device_execute"]
+                    + r["phases"]["host_wait"]) > 0.0
         # a round's spans precede it durably (the drill's replay claim)
         spans = read_flight(out, kind="span", name="stream.round")
         assert {s["round"] for s in spans} == {1, 2, 3}

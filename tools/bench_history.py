@@ -1,0 +1,260 @@
+"""Benchmark trajectory across the PR sequence + a regression gate.
+
+Every perf PR leaves a ``BENCH_pr*.json`` at the repo root — each with
+its own schema (the metric IS the PR's story), which is why nothing so
+far could answer "did PR N regress what PR N-3 won?".  This tool gives
+the BENCH_pr*.json trail two read sides:
+
+**Trajectory** (default)::
+
+    python tools/bench_history.py            # table over BENCH_pr*.json
+    python tools/bench_history.py --json     # machine-readable
+
+  One row per BENCH file: the PR tag, its metric/bench name, the
+  ``ok`` flag, bench wall seconds, and the file's *headline figures* —
+  numeric leaves whose key matches the well-known perf vocabulary
+  (``realtime_factor``, ``*speedup*``, ``overhead_pct``,
+  ``utilization``, ...) — so the cross-PR trend is one table even
+  though every schema differs.
+
+**Gate** (``--gate NEW --against OLD``)::
+
+    python tools/bench_history.py --gate BENCH_pr17.json \
+        --against BENCH_pr16.json --tolerance 0.15
+
+  Compares every headline path the two files SHARE, with direction
+  inferred from the key: ``speedup`` / ``realtime`` / ``factor`` /
+  ``utilization`` / ``throughput`` are higher-is-better; ``overhead``
+  / ``seconds`` / ``wall`` / ``lag`` / ``spread`` lower-is-better;
+  ambiguous keys are reported but never gate.  Exit 1 when any shared
+  figure is worse by more than ``--tolerance`` (relative), exit 0
+  otherwise — cheap enough for CI, honest enough to catch a perf PR
+  quietly unwinding an earlier one.  Disjoint schemas simply share
+  nothing: the gate passes and says so.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+__all__ = [
+    "compare_headlines",
+    "extract_headlines",
+    "load_bench",
+    "trajectory",
+]
+
+# the perf vocabulary: key regex -> direction ("up" = higher is
+# better, "down" = lower is better, None = report-only)
+_HEADLINE_PATTERNS = (
+    (re.compile(r"speedup", re.I), "up"),
+    (re.compile(r"realtime", re.I), "up"),
+    (re.compile(r"rt_factor|_rt$|^rt$", re.I), "up"),
+    (re.compile(r"throughput", re.I), "up"),
+    (re.compile(r"utilization", re.I), "up"),
+    (re.compile(r"overhead", re.I), "down"),
+    (re.compile(r"lag", re.I), "down"),
+    (re.compile(r"spread", re.I), "down"),
+    (re.compile(r"(wall|_seconds|_s)$", re.I), "down"),
+)
+# structural keys never treated as headlines even when numeric
+_SKIP_KEYS = re.compile(
+    r"^(fs|fs_hz|n_ch|channels|rounds|streams|seed|order|ratio|"
+    r"cycles|epochs|kills|window|limit|depth|width|widths|N|n)$"
+)
+
+
+def _direction(key: str):
+    for pat, d in _HEADLINE_PATTERNS:
+        if pat.search(key):
+            return d
+    return None
+
+
+def extract_headlines(doc, prefix="") -> dict:
+    """``{dotted.path: (value, direction)}`` for every numeric leaf
+    whose own key matches the perf vocabulary.  Lists index as
+    ``path[i]`` so sweep legs stay distinct and comparable."""
+    out: dict = {}
+    if isinstance(doc, dict):
+        for k, v in doc.items():
+            path = f"{prefix}.{k}" if prefix else str(k)
+            if isinstance(v, (dict, list)):
+                out.update(extract_headlines(v, path))
+            elif isinstance(v, bool):
+                continue
+            elif isinstance(v, (int, float)):
+                if _SKIP_KEYS.match(str(k)):
+                    continue
+                d = _direction(str(k))
+                if d is not None:
+                    out[path] = (float(v), d)
+    elif isinstance(doc, list):
+        for i, v in enumerate(doc):
+            out.update(extract_headlines(v, f"{prefix}[{i}]"))
+    return out
+
+
+def load_bench(path: str) -> dict:
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def _pr_tag(path: str) -> str:
+    name = os.path.basename(path)
+    m = re.match(r"BENCH_(pr\d+|r\d+\w*)\.json", name)
+    return m.group(1) if m else name
+
+
+def _bench_name(doc: dict) -> str:
+    for key in ("metric", "bench", "name"):
+        v = doc.get(key)
+        if isinstance(v, str):
+            return v
+    return "?"
+
+
+def trajectory(paths) -> list:
+    """One summary row per BENCH file, PR order."""
+    rows = []
+    for path in paths:
+        try:
+            doc = load_bench(path)
+        except (OSError, ValueError) as exc:
+            rows.append({"pr": _pr_tag(path), "error": str(exc)[:120]})
+            continue
+        heads = extract_headlines(doc)
+        # surface the few most informative figures: top-level first,
+        # then shallowest paths
+        picked = sorted(
+            heads.items(), key=lambda kv: (kv[0].count("."), kv[0])
+        )[:6]
+        rows.append({
+            "pr": _pr_tag(path),
+            "name": _bench_name(doc),
+            "ok": doc.get("ok"),
+            "bench_wall_s": doc.get("bench_wall_s"),
+            "headlines": {k: v[0] for k, v in picked},
+            "headline_count": len(heads),
+        })
+    return rows
+
+
+def compare_headlines(new_doc: dict, old_doc: dict,
+                      tolerance: float) -> dict:
+    """Gate verdict comparing every headline path the two docs share.
+    ``regressions`` lists shared directional figures worse (relative)
+    by more than ``tolerance``; ``passed`` is False iff any exist."""
+    new_h = extract_headlines(new_doc)
+    old_h = extract_headlines(old_doc)
+    shared = sorted(set(new_h) & set(old_h))
+    regressions = []
+    improved = []
+    for path in shared:
+        new_v, direction = new_h[path]
+        old_v, _ = old_h[path]
+        if direction is None or old_v == 0:
+            continue
+        # relative change signed so that positive = better
+        rel = (new_v - old_v) / abs(old_v)
+        if direction == "down":
+            rel = -rel
+        entry = {
+            "path": path, "old": old_v, "new": new_v,
+            "direction": direction, "relative_change": round(rel, 4),
+        }
+        if rel < -tolerance:
+            regressions.append(entry)
+        elif rel > tolerance:
+            improved.append(entry)
+    return {
+        "shared_paths": len(shared),
+        "tolerance": tolerance,
+        "regressions": regressions,
+        "improved": improved,
+        "passed": not regressions,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="repo root holding BENCH_pr*.json (default: repo)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--gate", default=None, metavar="NEW",
+                    help="regression-gate mode: the candidate BENCH "
+                         "json")
+    ap.add_argument("--against", default=None, metavar="OLD",
+                    help="baseline BENCH json for --gate (default: "
+                         "the newest BENCH_pr*.json before NEW)")
+    ap.add_argument("--tolerance", type=float, default=0.15,
+                    help="relative regression tolerance (default "
+                         "0.15 — benches on shared CI hosts are "
+                         "noisy)")
+    args = ap.parse_args(argv)
+
+    if args.gate is not None:
+        against = args.against
+        if against is None:
+            peers = sorted(
+                p for p in glob.glob(
+                    os.path.join(args.root, "BENCH_pr*.json"))
+                if os.path.abspath(p) != os.path.abspath(args.gate)
+            )
+            if not peers:
+                print("bench_history: no baseline BENCH_pr*.json "
+                      "found; gate passes vacuously")
+                return 0
+            against = peers[-1]
+        verdict = compare_headlines(
+            load_bench(args.gate), load_bench(against), args.tolerance
+        )
+        verdict["candidate"] = args.gate
+        verdict["baseline"] = against
+        if args.json:
+            print(json.dumps(verdict, indent=2))
+        else:
+            print(f"gate: {args.gate} vs {against} "
+                  f"(tolerance {args.tolerance:.0%}, "
+                  f"{verdict['shared_paths']} shared figures)")
+            for e in verdict["regressions"]:
+                print(f"  REGRESSED {e['path']}: {e['old']} -> "
+                      f"{e['new']} ({e['relative_change']:+.1%})")
+            for e in verdict["improved"]:
+                print(f"  improved  {e['path']}: {e['old']} -> "
+                      f"{e['new']} ({e['relative_change']:+.1%})")
+            print("PASS" if verdict["passed"] else "FAIL")
+        return 0 if verdict["passed"] else 1
+
+    paths = sorted(glob.glob(os.path.join(args.root, "BENCH_pr*.json")))
+    rows = trajectory(paths)
+    if args.json:
+        print(json.dumps(rows, indent=2))
+        return 0
+    print(f"{'pr':<8}{'bench':<28}{'ok':>4}{'wall_s':>9}  headlines")
+    print("-" * 100)
+    for r in rows:
+        if "error" in r:
+            print(f"{r['pr']:<8}{'<unreadable>':<28}     "
+                  f"    {r['error']}")
+            continue
+        heads = "  ".join(
+            f"{k}={v:g}" for k, v in r["headlines"].items()
+        )
+        ok = {True: "ok", False: "NO", None: "-"}[r["ok"]]
+        wall = ("-" if r["bench_wall_s"] is None
+                else f"{r['bench_wall_s']:.1f}")
+        print(f"{r['pr']:<8}{r['name'][:27]:<28}{ok:>4}{wall:>9}  "
+              f"{heads[:120]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -161,6 +161,15 @@ REQUIRED_METRICS = (
     "tpudas_fleet_batch_stacked_members_total",
     "tpudas_fleet_batch_solo_launches_total",
     "tpudas_fleet_batch_sig_memo_total",
+    # device telemetry plane (PR 17): tools/fleet_bench.py's devprof
+    # columns and GET /devprof read these by name; OBSERVABILITY.md
+    # "Device telemetry" points dashboards at them
+    "tpudas_devprof_launches_total",
+    "tpudas_devprof_device_seconds_total",
+    "tpudas_devprof_compiles_total",
+    "tpudas_devprof_compile_seconds_total",
+    "tpudas_devprof_recompile_storm",
+    "tpudas_devprof_utilization",
 )
 REQUIRED_SPANS = (
     "serve.request",
@@ -190,6 +199,8 @@ REQUIRED_SPANS = (
     # ragged-batched fleet execution (PR 16)
     "fleet.batch",
     "op.stacked",
+    # device telemetry plane (PR 17)
+    "obs.devprof",
 )
 
 

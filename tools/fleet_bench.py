@@ -120,6 +120,47 @@ def _metric(name, **labels) -> float:
         return 0.0
 
 
+def _metric_total(name, **match) -> float:
+    """Sum a labeled counter over every series (optionally filtered by
+    exact label values) — the registry read for the devprof totals,
+    whose series are keyed ``{engine, stacked, stream}``."""
+    from tpudas.obs.registry import get_registry
+
+    m = get_registry().get(name)
+    if m is None or not hasattr(m, "_series"):
+        return 0.0
+    total = 0.0
+    for labels, value in m._series():
+        if all(labels.get(k) == v for k, v in match.items()):
+            total += float(value)
+    return total
+
+
+def _devprof_stats(rounds: int) -> dict:
+    """The device-telemetry column (ISSUE 17) read back from the
+    registry after a fleet run: true launch counts and device-execute
+    seconds (stacked launches count 1/N per member, so the sums are
+    launch-true), plus the per-stream live classification."""
+    from tpudas.obs import devprof
+
+    launches = _metric_total("tpudas_devprof_launches_total")
+    device_s = _metric_total("tpudas_devprof_device_seconds_total")
+    stacked_launches = _metric_total(
+        "tpudas_devprof_launches_total", stacked="1"
+    )
+    snap = devprof.devprof_snapshot(calibrate=True)
+    return {
+        "launches_total": round(launches, 3),
+        "stacked_launches_total": round(stacked_launches, 3),
+        "device_seconds_total": round(device_s, 6),
+        "launches_per_round": round(launches / rounds, 3),
+        "device_seconds_per_round": round(device_s / rounds, 6),
+        "compiles": snap["compile"]["count"],
+        "compile_seconds": snap["compile"]["seconds"],
+        "streams": snap["streams"],
+    }
+
+
 def run_scale_child(n_streams, fs, n_ch, file_sec, feeds=2,
                     batched=False, poll_jitter=None) -> dict:
     """One fresh-process scale point: an N-stream fleet, 2 files
@@ -205,6 +246,7 @@ def run_scale_child(n_streams, fs, n_ch, file_sec, feeds=2,
         "channels": n_ch,
         "batched": bool(batched),
         "batch": batch_stats,
+        "devprof": _devprof_stats(rounds),
         "data_seconds_per_stream": data_sec_per_stream,
         "rounds_total": summary["rounds_total"],
         "wall_seconds": round(wall, 3),
@@ -267,6 +309,13 @@ def bench_ops_stacked(n_list, fs=1000.0, n_ch=8, block_sec=2.0,
         design_cascade,
     )
 
+    from tpudas.obs import devprof
+
+    # fresh telemetry state: the launch-floor / peak calibration
+    # probes re-run HERE, adjacent to the measurement, instead of
+    # inheriting figures measured under whatever load earlier legs
+    # left behind (stale peaks skew utilization both ways)
+    devprof.reset()
     ratio = int(round(fs * DT_OUT))
     plan = design_cascade(fs, ratio, 0.45 / DT_OUT, 4)
     T = int(round(block_sec * fs))
@@ -302,8 +351,16 @@ def bench_ops_stacked(n_list, fs=1000.0, n_ch=8, block_sec=2.0,
         jax.block_until_ready(
             [y for y, _c in run_seq()] + [y for y, _c in run_stacked()]
         )  # compile both paths outside the timed region
-        t_seq = timed(run_seq)
+        # warm solo launches under a devprof stream scope: the live
+        # launch-bound vs compute-bound read for THIS geometry, to be
+        # checked against the measured stacking speedup (ISSUE 17
+        # acceptance: classification agrees with the PR 16 crossover)
+        dev_sid = f"ops_{n_ch}ch_{T}r"
+        with devprof.stream_scope(dev_sid):
+            t_seq = timed(run_seq)
         t_stk = timed(run_stacked)
+        devprof.round_collect(dev_sid)
+        cls = devprof.classify_stream(dev_sid) or {}
         data_sec = n * block_sec
         entry = {
             "streams": n,
@@ -316,13 +373,21 @@ def bench_ops_stacked(n_list, fs=1000.0, n_ch=8, block_sec=2.0,
             "speedup": round(t_seq / t_stk, 2),
             "sequential_aggregate_rt": round(data_sec / t_seq, 1),
             "stacked_aggregate_rt": round(data_sec / t_stk, 1),
+            "devprof": {
+                "mean_launch_seconds": cls.get("mean_launch_seconds"),
+                "launch_ratio": cls.get("launch_ratio"),
+                "bound": cls.get("bound"),
+                "utilization": cls.get("utilization"),
+            },
         }
         results.append(entry)
         print(
             f"fleet_bench: ops_stacked N={n} "
             f"seq={entry['sequential_wall_s']}s "
             f"stacked={entry['stacked_wall_s']}s "
-            f"speedup={entry['speedup']}x"
+            f"speedup={entry['speedup']}x "
+            f"bound={entry['devprof']['bound']} "
+            f"launch_ratio={entry['devprof']['launch_ratio']}"
         )
     return results
 
@@ -535,7 +600,9 @@ def main(argv=None) -> int:
                 f"fleet_bench: N={n} batched={int(leg_batched)} "
                 f"aggregate_rt={rep['aggregate_realtime_factor']} "
                 f"launches_per_round="
-                f"{rep['batch']['launches_per_round']} "
+                f"{rep['devprof']['launches_per_round']} "
+                f"device_s_per_round="
+                f"{rep['devprof']['device_seconds_per_round']} "
                 f"sched_overhead={rep['sched_overhead_pct']}% "
                 f"compile_share={rep['compile_share']}"
             )
@@ -558,6 +625,23 @@ def main(argv=None) -> int:
                 ),
                 "batched_launches_per_round": v["batched"]["batch"][
                     "launches_per_round"
+                ],
+                # devprof columns (ISSUE 17): true launch counts and
+                # device-execute seconds from the telemetry plane's
+                # registry counters — the sequential leg finally has a
+                # launch count too (the tpudas_fleet_batch_* counters
+                # only ever saw the batch executor's dispatches)
+                "sequential_launches_per_round": v["sequential"][
+                    "devprof"
+                ]["launches_per_round"],
+                "batched_devprof_launches_per_round": v["batched"][
+                    "devprof"
+                ]["launches_per_round"],
+                "sequential_device_s_per_round": v["sequential"][
+                    "devprof"
+                ]["device_seconds_per_round"],
+                "batched_device_s_per_round": v["batched"]["devprof"][
+                    "device_seconds_per_round"
                 ],
                 "lag_spread_sequential": v["sequential"][
                     "head_lag_seconds"

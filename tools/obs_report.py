@@ -51,12 +51,13 @@ def print_text(snap: dict) -> None:
         header = (
             f"{'stream':<16}{'status':>10}{'rounds':>8}"
             f"{'rt_factor':>10}{'head_lag':>10}{'slo':>10}"
-            f"{'burn':>7}  last_error"
+            f"{'burn':>7}{'dev_util':>9}{'bound':>14}  last_error"
         )
         print(header)
         print("-" * len(header))
         for sid, e in sorted(fleet["streams"].items()):
             slo = e.get("slo", {})
+            dev = e.get("devprof") or {}
             err = e.get("last_error") or ""
             fleet_ev = e.get("fleet")
             if fleet_ev:
@@ -68,7 +69,9 @@ def print_text(snap: dict) -> None:
                 f"{_fmt(e.get('realtime_factor'), 10)}"
                 f"{_fmt(e.get('head_lag_seconds'), 10)}"
                 f"{slo.get('status', '-'):>10}"
-                f"{_fmt(slo.get('error_budget_burn'), 7)}  "
+                f"{_fmt(slo.get('error_budget_burn'), 7)}"
+                f"{_fmt(dev.get('utilization'), 9)}"
+                f"{str(dev.get('bound') or '-'):>14}  "
                 f"{str(err)[:48]}"
             )
     bf = snap.get("backfill")

@@ -538,10 +538,10 @@ def run(out_path, rounds=4, files_per_round=2):
     if phases:
         total = sum(p["sum"] for p in phases.values()) or 1.0
         print("round-phase breakdown (stateful mode):")
-        print(f"  {'phase':<12}{'mean_s':>10}{'share':>8}")
+        print(f"  {'phase':<15}{'mean_s':>10}{'share':>8}")
         for name, p in phases.items():
             print(
-                f"  {name:<12}{p['mean']:>10.4f}"
+                f"  {name:<15}{p['mean']:>10.4f}"
                 f"{100.0 * p['sum'] / total:>7.1f}%"
             )
     print(json.dumps(report))
@@ -855,10 +855,10 @@ def _print_phase_table(title, phases):
         return
     total = sum(p["sum"] for p in phases.values()) or 1.0
     print(f"round-phase breakdown ({title}):")
-    print(f"  {'phase':<12}{'mean_s':>10}{'share':>8}")
+    print(f"  {'phase':<15}{'mean_s':>10}{'share':>8}")
     for name, p in phases.items():
         print(
-            f"  {name:<12}{p['mean']:>10.4f}"
+            f"  {name:<15}{p['mean']:>10.4f}"
             f"{100.0 * p['sum'] / total:>7.1f}%"
         )
 

@@ -248,6 +248,8 @@ class BatchStepExecutor:
         Member order inside a wave is sorted by stream id, so the
         stacked compile key (the widths tuple) is deterministic for a
         given fleet."""
+        from tpudas.obs.devprof import wave_scope
+
         reg = get_registry()
         waves: dict = {}
         for m in sorted(batch):
@@ -255,24 +257,28 @@ class BatchStepExecutor:
         for key, members in waves.items():
             pend = [batch[m] for m in members]
             try:
-                if len(members) >= 2:
-                    reg.counter(
-                        "tpudas_fleet_batch_stacked_launches_total",
-                        "stacked device programs dispatched (>= 2 "
-                        "streams in one launch)",
-                    ).inc()
-                    reg.counter(
-                        "tpudas_fleet_batch_stacked_members_total",
-                        "stream steps served by a stacked launch",
-                    ).inc(len(members))
-                    results = self._run_stacked(key, pend)
-                else:
-                    reg.counter(
-                        "tpudas_fleet_batch_solo_launches_total",
-                        "batch-executor dispatches that ran solo (no "
-                        "co-shaped peer in the rendezvous)",
-                    ).inc()
-                    results = [self._run_solo(key, pend[0])]
+                # devprof attribution: wave launches run on the ONE
+                # dispatching member's thread, so the wave's member
+                # list — not the thread's stream scope — is the truth
+                with wave_scope(members):
+                    if len(members) >= 2:
+                        reg.counter(
+                            "tpudas_fleet_batch_stacked_launches_total",
+                            "stacked device programs dispatched (>= 2 "
+                            "streams in one launch)",
+                        ).inc()
+                        reg.counter(
+                            "tpudas_fleet_batch_stacked_members_total",
+                            "stream steps served by a stacked launch",
+                        ).inc(len(members))
+                        results = self._run_stacked(key, pend)
+                    else:
+                        reg.counter(
+                            "tpudas_fleet_batch_solo_launches_total",
+                            "batch-executor dispatches that ran solo "
+                            "(no co-shaped peer in the rendezvous)",
+                        ).inc()
+                        results = [self._run_solo(key, pend[0])]
             except BaseException as exc:
                 for p in pend:
                     p.error = exc

@@ -45,6 +45,7 @@ import numpy as np
 from tpudas.core.timeutils import to_datetime64, to_timedelta64
 from tpudas.fleet.config import StreamSpec
 from tpudas.io.spool import spool as make_spool
+from tpudas.obs import devprof as _devprof
 from tpudas.obs.flight import capture as flight_capture
 from tpudas.obs.health import write_health, write_prom
 from tpudas.obs.phases import RoundPhases
@@ -628,7 +629,12 @@ class LowpassStreamRunner(StreamRunner):
         # during the step land in this stream's flight recorder
         ph = self._round_phases = RoundPhases()
         try:
-            with flight_capture(self.flight):
+            # devprof stream scope: jit launches dispatched on this
+            # thread during the round attribute to this stream (the
+            # batch executor's wave scope overrides for cross-thread
+            # rendezvous dispatches)
+            with flight_capture(self.flight), \
+                    _devprof.stream_scope(self.stream_id):
                 fault_point("round.body", poll=self.polls)
                 # quarantine exclusion + index update + scan-failure
                 # strikes + slow-schedule probe bookkeeping
@@ -878,10 +884,16 @@ class LowpassStreamRunner(StreamRunner):
         ph.add("read_decode", assemble_s)
         ph.add("place", place_s)
         ph.add("commit", write_s)
-        ph.add(
-            "compute",
-            max(proc_wall - assemble_s - write_s - place_s, 0.0),
-        )
+        # device telemetry round boundary (ISSUE 17): the ONE deferred
+        # block_until_ready sync finalizes this round's in-flight
+        # launches, and the former `compute` phase splits into what the
+        # DEVICE executed vs what the host spent waiting/gluing —
+        # clamped so async overlap can never over-charge the round
+        dev = _devprof.round_collect(self.stream_id)
+        compute_s = max(proc_wall - assemble_s - write_s - place_s, 0.0)
+        dev_s = min(float(dev.get("device_execute_s", 0.0)), compute_s)
+        ph.add("device_execute", dev_s)
+        ph.add("host_wait", compute_s - dev_s)
         self.prev_t2 = t2
         self.rounds = rnd
         self.round_rt = (
@@ -986,6 +998,12 @@ class LowpassStreamRunner(StreamRunner):
                 else round(self.head_lag, 3)
             ),
             phases=phases_rec,
+            devprof={
+                "launches": dev.get("launches", 0.0),
+                "device_execute_s": round(dev_s, 6),
+                "bound": dev.get("bound"),
+                "utilization": dev.get("utilization"),
+            },
         )
         self._flight_flush()
         if self.on_round is not None:
@@ -1194,7 +1212,8 @@ class RollingStreamRunner(StreamRunner):
         self.polls += 1
         ph = self._round_phases = RoundPhases()
         try:
-            with flight_capture(self.flight):
+            with flight_capture(self.flight), \
+                    _devprof.stream_scope(self.stream_id):
                 fault_point("round.body", poll=self.polls)
                 with ph.measure("poll"):
                     sp = self.boundary.begin_round(
@@ -1323,7 +1342,14 @@ class RollingStreamRunner(StreamRunner):
         # is compute (rolling reads inside .rolling()/.mean())
         loop_wall = _time.perf_counter() - t_loop0
         ph.add("commit", write_s[0])
-        ph.add("compute", max(loop_wall - write_s[0], 0.0))
+        # rolling ops are not devprof-instrumented (no stream-step jit
+        # entrypoint), so the delta is usually 0 and the former
+        # `compute` residual lands in host_wait — honest, not hidden
+        dev = _devprof.round_collect(self.stream_id)
+        compute_s = max(loop_wall - write_s[0], 0.0)
+        dev_s = min(float(dev.get("device_execute_s", 0.0)), compute_s)
+        ph.add("device_execute", dev_s)
+        ph.add("host_wait", compute_s - dev_s)
         # driver parity with the lowpass runner: the same per-round
         # serve/detect append hooks over the same in-memory capture
         if self.pyramid and not _resource.should_shed("pyramid"):
@@ -1353,6 +1379,12 @@ class RollingStreamRunner(StreamRunner):
         self._flight_record(
             "round", round=rnd, mode="rolling",
             patches=len(fresh), phases=phases_rec,
+            devprof={
+                "launches": dev.get("launches", 0.0),
+                "device_execute_s": round(dev_s, 6),
+                "bound": dev.get("bound"),
+                "utilization": dev.get("utilization"),
+            },
         )
         self._flight_flush()
 

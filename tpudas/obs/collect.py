@@ -54,6 +54,7 @@ __all__ = [
     "SLOPolicy",
     "backfill_rollup",
     "cluster_snapshot",
+    "devprof_entry",
     "fleet_rollup",
     "health_entry",
     "overall_status",
@@ -158,6 +159,58 @@ def health_entry(health) -> dict:
     return entry
 
 
+def devprof_entry(rounds) -> dict | None:
+    """Fold the flight ring's per-round ``devprof`` records (ISSUE 17:
+    :func:`tpudas.obs.devprof.round_collect` deltas the runner stamps
+    into every ``round`` record) into the rollup's device-telemetry
+    column: mean launches per round, total device-execute seconds,
+    the device-busy fraction of round wall time, and the newest live
+    ``bound`` classification / roofline utilization.  ``None`` when no
+    round carries devprof (pre-PR-17 ring, or ``TPUDAS_DEVPROF=0``) —
+    read-only over the crash-surviving ring like everything here, so
+    it works post-mortem and cross-process."""
+    recs = [
+        r for r in rounds or []
+        if isinstance(r.get("devprof"), dict)
+    ]
+    if not recs:
+        return None
+    launches = 0.0
+    dev_s = 0.0
+    wall = 0.0
+    for r in recs:
+        dp = r["devprof"]
+        launches += float(dp.get("launches") or 0.0)
+        dev_s += float(dp.get("device_execute_s") or 0.0)
+        phases = r.get("phases") or {}
+        wall += sum(
+            float(v) for v in phases.values()
+            if isinstance(v, (int, float))
+        )
+    # the newest round that actually classified (a zero-launch round
+    # reads bound=None; don't let it mask the last real reading)
+    bound = None
+    utilization = None
+    for r in reversed(recs):
+        dp = r["devprof"]
+        if bound is None and dp.get("bound") is not None:
+            bound = dp["bound"]
+        if utilization is None and dp.get("utilization") is not None:
+            utilization = dp["utilization"]
+        if bound is not None and utilization is not None:
+            break
+    return {
+        "rounds": len(recs),
+        "launches_per_round": round(launches / len(recs), 3),
+        "device_execute_s": round(dev_s, 6),
+        "device_busy_fraction": (
+            round(dev_s / wall, 4) if wall > 0 else None
+        ),
+        "bound": bound,
+        "utilization": utilization,
+    }
+
+
 def stream_snapshot(folder, policy: SLOPolicy | None = None) -> dict:
     """One stream folder's rollup entry: verified health + SLO +
     flight freshness + the fleet park/unpark event (timestamps
@@ -177,6 +230,10 @@ def stream_snapshot(folder, policy: SLOPolicy | None = None) -> dict:
             "last_round_at": rounds[-1].get("ts"),
             "phases": rounds[-1].get("phases"),
         }
+    # device telemetry (ISSUE 17): same ring scan, one more fold
+    dev = devprof_entry(rounds)
+    if dev is not None:
+        entry["devprof"] = dev
     return entry
 
 

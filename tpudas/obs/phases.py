@@ -17,8 +17,14 @@ phase           what it covers (lowpass runner)
                 (``LFProc.timings["assemble_s"]``)
 ``place``       explicit H2D pad-and-place onto the mesh (the
                 ``parallel.place`` span time; 0 unsharded)
-``compute``     the remainder of the processing call — kernel
-                dispatch through host sync plus engine glue
+``device_execute``  dispatch-to-ready device seconds of the round's
+                jit launches, measured by the device telemetry plane
+                (:mod:`tpudas.obs.devprof` — deferred
+                ``block_until_ready`` deltas, clamped to the round's
+                compute residual)
+``host_wait``   the remainder of the processing call — host sync
+                waits, engine glue, and (with ``TPUDAS_DEVPROF=0``)
+                the whole former ``compute`` phase
 ``commit``      output HDF5 writes (``timings["write_s"]``) + the
                 carry save
 ``pyramid``     the per-round tile-pyramid append
@@ -58,7 +64,8 @@ PHASES = (
     "poll",
     "read_decode",
     "place",
-    "compute",
+    "device_execute",
+    "host_wait",
     "commit",
     "pyramid",
     "detect",
@@ -115,8 +122,8 @@ class RoundPhases:
         hist = reg.histogram(
             "tpudas_stream_round_phase_seconds",
             "per-round wall seconds by round-loop phase (poll / "
-            "read_decode / place / compute / commit / pyramid / "
-            "detect / health)",
+            "read_decode / place / device_execute / host_wait / "
+            "commit / pyramid / detect / health)",
             labelnames=("phase",),
         )
         out = {}

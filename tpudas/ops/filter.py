@@ -19,6 +19,7 @@ and lf_das.py:223 (engine, corner = 0.45/dt low-pass).
 from __future__ import annotations
 
 import functools
+import time as _time
 
 import jax
 import jax.numpy as jnp
@@ -218,6 +219,7 @@ def fft_pass_filter_stream(block, carry, d_sec, low=None, high=None,
             f"carry must be (2*edge, C), got {tuple(np.shape(carry))}"
         )
     T = int(np.shape(block)[0])
+    from tpudas.obs import devprof
     from tpudas.obs.trace import span
 
     edge = rows_carry // 2
@@ -237,8 +239,19 @@ def fft_pass_filter_stream(block, carry, d_sec, low=None, high=None,
             float(d_sec), low, high, int(order), None, ch_axis,
             quantized=quantized,
         )
+        shape_key = (
+            T, rows_carry, int(block.shape[1]), float(d_sec), low,
+            high, int(order), int(quantized),
+        )
+        devprof.note_kernel("fft", shape_key, ())
+        cost = devprof.kernel_cost(
+            "fft", shape_key, fn, (block, carry) + args
+        )
+        t0 = _time.perf_counter()
         with span("op.fft_stream", rows=T, edge=edge):
-            return fn(block, carry, *args)
+            out = fn(block, carry, *args)
+        devprof.note_launch("fft", t0, out, cost=cost)
+        return out
     from tpudas.parallel.sharding import channel_pad, place_block
 
     C = int(np.shape(block)[1])
@@ -259,11 +272,19 @@ def fft_pass_filter_stream(block, carry, d_sec, low=None, high=None,
         T, rows_carry, Cp, float(d_sec), low, high, int(order),
         mesh, ch_axis, quantized=quantized,
     )
+    shape_key = (
+        T, rows_carry, Cp, float(d_sec), low, high, int(order),
+        int(quantized), int(mesh.shape[ch_axis]),
+    )
+    devprof.note_kernel("fft", shape_key, ())
+    cost = devprof.kernel_cost("fft", shape_key, fn, (xs, carry) + args)
+    t0 = _time.perf_counter()
     with span(
         "op.fft_stream", rows=T, edge=edge,
         shards=int(mesh.shape[ch_axis]),
     ):
         out, new_carry = fn(xs, carry, *args)
+    devprof.note_launch("fft", t0, (out, new_carry), cost=cost)
     return (out[:, :C] if Cp != C else out), new_carry
 
 
@@ -377,13 +398,25 @@ def fft_pass_filter_stream_stacked(blocks, carries, d_sec, low=None,
         T, rows_carry, widths, float(d_sec), low, high, int(order),
         mesh, ch_axis, quantized=quantized,
     )
+    from tpudas.obs import devprof
     from tpudas.obs.trace import span
 
+    shape_key = (
+        T, rows_carry, widths, float(d_sec), low, high, int(order),
+        int(quantized),
+    )
+    devprof.note_kernel("fft_stacked", shape_key, ())
     args = (jnp.float32(qscale),) if quantized else ()
+    cost = devprof.kernel_cost(
+        "fft_stacked", shape_key, fn, (blocks, carries) + args
+    )
+    t0 = _time.perf_counter()
     with span(
         "op.stacked", rows=T, streams=len(blocks), edge=rows_carry // 2,
     ):
         outs, news = fn(blocks, carries, *args)
+    devprof.note_launch("fft", t0, (outs, news), cost=cost,
+                        stacked=True)
     return list(zip(outs, news))
 
 

@@ -42,6 +42,7 @@ this engine analytically.
 from __future__ import annotations
 
 import functools
+import time as _time
 from dataclasses import dataclass
 
 import numpy as np
@@ -121,6 +122,16 @@ def knob_fingerprint() -> tuple:
     import os
 
     return tuple(os.environ.get(n, "").strip() for n in _KNOB_ENVS)
+
+
+def _plan_tag(plan) -> str:
+    """Compact stable tag of a CascadePlan for devprof kernel keys —
+    distinct plans must read as distinct shapes, but the key has to
+    stay printable (the /devprof kernel log shows it)."""
+    return (
+        f"r{plan.ratio}s{len(plan.stages)}"
+        f"h{hash(plan) & 0xFFFFFF:06x}"
+    )
 
 
 def butter2_mag(f, corner, order):
@@ -1186,6 +1197,7 @@ def cascade_decimate_stream(x, carry, plan: CascadePlan, engine="auto",
             "carry does not match this plan's stream_carry_sizes "
             f"({[int(np.shape(b)[0]) for b in carry]} vs {list(sizes)})"
         )
+    from tpudas.obs import devprof
     from tpudas.obs.trace import span
 
     knobs = knob_fingerprint()
@@ -1198,12 +1210,17 @@ def cascade_decimate_stream(x, carry, plan: CascadePlan, engine="auto",
             fn = _build_stream_cascade_fn(plan, T, n_ch, engine,
                                           knobs=knobs, quantized=quantized)
             sp = span("op.cascade_stream", rows=T, engine=engine)
+        shape_key = (T, n_ch, engine, int(quantized), _plan_tag(plan))
+        devprof.note_kernel("cascade", shape_key, knobs)
         args = (jnp.float32(qscale),) if quantized else ()
+        bufs = tuple(jnp.asarray(b, jnp.float32) for b in carry)
+        cost = devprof.kernel_cost(
+            "cascade", shape_key, fn, (x, bufs) + args
+        )
+        t0 = _time.perf_counter()
         with sp:
-            out = fn(
-                x, tuple(jnp.asarray(b, jnp.float32) for b in carry),
-                *args,
-            )
+            out = fn(x, bufs, *args)
+        devprof.note_launch(engine, t0, out, cost=cost)
         if fused:
             _count_fused(plan, T, n_ch, engine)
         return out
@@ -1237,9 +1254,19 @@ def cascade_decimate_stream(x, carry, plan: CascadePlan, engine="auto",
                                       knobs=knobs, quantized=quantized)
         sp = span("op.cascade_stream", rows=T, engine=engine,
                   shards=int(mesh.shape[ch_axis]))
+    shape_key = (
+        T, Cp, engine, int(quantized), _plan_tag(plan),
+        int(mesh.shape[ch_axis]),
+    )
+    devprof.note_kernel("cascade", shape_key, knobs)
     args = (jnp.float32(qscale),) if quantized else ()
+    cost = devprof.kernel_cost(
+        "cascade", shape_key, fn, (xs, tuple(carry)) + args
+    )
+    t0 = _time.perf_counter()
     with sp:
         y, bufs = fn(xs, tuple(carry), *args)
+    devprof.note_launch(engine, t0, (y, bufs), cost=cost)
     if fused:
         _count_fused(plan, T, C, engine)
     return (y[:, :C] if Cp != C else y), bufs
@@ -1444,15 +1471,25 @@ def cascade_decimate_stream_stacked(blocks, carries, plan: CascadePlan,
             )
         _check_quantized(b, qscale)
     quantized = qscale is not None
+    knobs = knob_fingerprint()
     fn = _build_stacked_stream_fn(
         plan, T, widths, engine, mesh, ch_axis,
-        knobs=knob_fingerprint(), quantized=quantized,
+        knobs=knobs, quantized=quantized,
     )
+    from tpudas.obs import devprof
     from tpudas.obs.trace import span
 
+    shape_key = (T, widths, engine, int(quantized), _plan_tag(plan))
+    devprof.note_kernel("cascade_stacked", shape_key, knobs)
     args = (jnp.float32(qscale),) if quantized else ()
+    cost = devprof.kernel_cost(
+        "cascade_stacked", shape_key, fn, (blocks, carries) + args
+    )
+    t0 = _time.perf_counter()
     with span("op.stacked", rows=T, streams=len(blocks), engine=engine):
         outs, news = fn(blocks, carries, *args)
+    devprof.note_launch(engine, t0, (outs, news), cost=cost,
+                        stacked=True)
     if engine == "fused-xla":
         for w in widths:
             _count_fused(plan, T, w, engine)

@@ -139,6 +139,7 @@ class _Mount:
         self._events_cache = None
         self._score_store_cache = None
         self._slo_cache = None
+        self._devprof_cache = None
 
 
 def _slo_status_cached(mount, policy, health=None):
@@ -178,6 +179,34 @@ def _slo_status_cached(mount, policy, health=None):
     result = slo_status(mount.folder, policy, health=health)
     if key is not None:
         mount._slo_cache = (key, result)
+    return result
+
+
+def _devprof_entry_cached(mount):
+    """The flight ring's devprof fold (ISSUE 17:
+    :func:`tpudas.obs.collect.devprof_entry`), cached on the mount
+    keyed by the newest flight segment's ``(mtime_ns, size)`` — the
+    same stat-gated discipline as the SLO cache, for the same reason:
+    ``/fleet/healthz`` polls must not rescan the ring per request."""
+    from tpudas.obs.collect import devprof_entry
+    from tpudas.obs.flight import read_flight, segment_paths
+
+    segs = segment_paths(mount.folder)
+    if not segs:
+        return None
+    try:
+        st = os.stat(segs[-1])
+        key = (segs[-1], st.st_mtime_ns, st.st_size)
+    except OSError:
+        key = None
+    if key is not None:
+        cached = mount._devprof_cache
+        if cached is not None and cached[0] == key:
+            return cached[1]
+    result = devprof_entry(read_flight(mount.folder, kind="round",
+                                       limit=200))
+    if key is not None:
+        mount._devprof_cache = (key, result)
     return result
 
 
@@ -468,6 +497,10 @@ class _Handler(BaseHTTPRequestHandler):
             return self._trace(mount, params, stream_id)
         if endpoint == "/slo":
             return self._slo(mount, params, stream_id)
+        if endpoint == "/devprof":
+            return self._devprof(params)
+        if endpoint == "/profile":
+            return self._profile(params)
         if endpoint in (*_DATA_ENDPOINTS, "/healthz") and mount is None:
             # fleet-only server, bare endpoint: point at the routes
             self._send_json(
@@ -543,6 +576,11 @@ class _Handler(BaseHTTPRequestHandler):
             entry["slo"] = _slo_status_cached(
                 mounts[sid], policy, health=payload
             )
+            # device telemetry column (ISSUE 17): bound classification
+            # + roofline utilization from the stream's flight ring
+            dev = _devprof_entry_cached(mounts[sid])
+            if dev is not None:
+                entry["devprof"] = dev
             slo_counts[entry["slo"]["status"]] = (
                 slo_counts.get(entry["slo"]["status"], 0) + 1
             )
@@ -647,6 +685,53 @@ class _Handler(BaseHTTPRequestHandler):
                     "streams": streams,
                 }
         self._send_json(200, payload)
+        return 200
+
+    def _devprof(self, params: dict) -> int:
+        """Device telemetry snapshot (ISSUE 17): per-kernel launch and
+        device-execute accounting, compile / recompile-storm state,
+        one-time cost captures and the live launch-bound vs
+        compute-bound classification per stream.  Process-wide (the
+        device is shared) and control plane: bypasses the admission
+        gate — profiling a saturated server is the point."""
+        from tpudas.obs import devprof
+
+        calibrate = str(params.get("calibrate", "1")).lower() not in (
+            "0", "false", "no",
+        )
+        self._send_json(
+            200, devprof.devprof_snapshot(calibrate=calibrate)
+        )
+        return 200
+
+    def _profile(self, params: dict) -> int:
+        """Time-boxed ``jax.profiler`` trace into TPUDAS_PROFILE_DIR
+        without restarting the stream: ``?seconds=N`` starts one,
+        bare ``/profile`` reports status.  501 when the profiler is
+        unavailable in this runtime, 409 while a capture is already
+        running, 503 when disk pressure sheds the write."""
+        from tpudas.obs import devprof
+
+        if "seconds" not in params:
+            self._send_json(200, devprof.profile_status())
+            return 200
+        if not devprof.profiler_available():
+            self._send_json(
+                501,
+                {"error": "jax.profiler is unavailable in this "
+                          "runtime; install a jax build with profiler "
+                          "support or inspect /devprof instead"},
+            )
+            return 501
+        seconds = float(params["seconds"])
+        out_dir = params.get("dir") or None
+        try:
+            info = devprof.start_profile(seconds, out_dir=out_dir)
+        except RuntimeError as exc:
+            status = 409 if "already" in str(exc).lower() else 503
+            self._send_json(status, {"error": str(exc)[:300]})
+            return status
+        self._send_json(200, info)
         return 200
 
     # -- data plane ----------------------------------------------------
