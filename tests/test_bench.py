@@ -108,6 +108,7 @@ class TestCompareVisibility:
         assert "int16" not in result
         assert "budget" in result["int16_skipped"]
 
+    @pytest.mark.slow
     def test_pallas_failure_falls_back_to_xla(self, monkeypatch, capsys):
         """A Mosaic/compile failure of the fast path must not cost the
         round's headline: the child re-measures on cascade-xla and

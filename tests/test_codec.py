@@ -479,6 +479,7 @@ class TestHTTPCaching:
                     == rb.headers["X-Tpudas-Source"]
                 )
 
+    @pytest.mark.slow
     def test_etag_304_and_cache_control(self, twin_stores):
         from tpudas.serve.http import start_server
 
@@ -512,6 +513,7 @@ class TestHTTPCaching:
             )
             assert head.headers["Cache-Control"] == "no-cache"
 
+    @pytest.mark.slow
     def test_deflate_q0_is_refusal(self, twin_stores):
         from tpudas.serve.http import start_server
 
@@ -545,6 +547,7 @@ class TestHTTPCaching:
                 )
             assert err.value.code == 304
 
+    @pytest.mark.slow
     def test_torn_tile_never_served_immutable(self, twin_stores):
         """A tile that fails its crc must 500, not be handed to a
         CDN with a year-long immutable header."""
@@ -565,6 +568,7 @@ class TestHTTPCaching:
                     )
                 assert err.value.code == 500
 
+    @pytest.mark.slow
     def test_deflate_negotiation(self, twin_stores):
         from tpudas.serve.http import start_server
 

@@ -51,6 +51,7 @@ def test_lf_das_public_surface():
     assert isinstance(LFProc().parameters, FrozenDict)
 
 
+@pytest.mark.slow
 def test_batch_low_pass_notebook_flow(data_path, tmp_path):
     """low_pass_dascore.ipynb cells 3-11 condensed."""
     output_data_folder = str(tmp_path / "results")
@@ -112,6 +113,7 @@ def test_batch_low_pass_notebook_flow(data_path, tmp_path):
     assert ax is not None
 
 
+@pytest.mark.slow
 def test_waterfall_plot_signature(data_path, tmp_path):
     """lf_das.waterfall_plot with the notebook's (channel x time) input."""
     rng = np.random.default_rng(0)

@@ -163,6 +163,7 @@ class TestOperatorContract:
                   "baseline": 20.0})],
         ids=["stalta", "rms"],
     )
+    @pytest.mark.slow
     def test_chunk_invariance(self, spec):
         """The contract's rule 1: any chunking of the same row stream
         produces bit-identical events, scores, and final state."""
@@ -373,6 +374,7 @@ class TestScoreStore:
 
 
 class TestDriverIntegration:
+    @pytest.mark.slow
     def test_artifacts_events_metrics_health(self, tmp_path, monkeypatch):
         monkeypatch.setenv("TPUDAS_HEALTH", "1")
         src, out = str(tmp_path / "src"), str(tmp_path / "out")
@@ -841,6 +843,7 @@ class TestEventsEndpoint:
             _, body3, _ = self._get(srv, "/events?limit=100000")
             assert body3["ledger_events"] == len(evs) + 1
 
+    @pytest.mark.slow
     def test_scores_degrade_on_torn_store(self, tmp_path):
         """Committed partial rows with a torn tails.npy make
         ScoreStore.open raise; ``/events?scores=1`` must degrade to

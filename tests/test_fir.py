@@ -112,6 +112,7 @@ class TestApply:
         b = np.asarray(cascade_decimate(x, plan, 3000 + 2 * ratio, 8, engine="xla"))
         assert np.abs(a[2:] - b[:8]).max() < 1e-6
 
+    @pytest.mark.slow
     def test_pallas_interpret_matches_xla(self):
         ratio = 100
         plan = design_cascade(100.0, ratio, CORNER, 4)
@@ -177,6 +178,7 @@ class TestPallasKernel:
             },
         ],
     )
+    @pytest.mark.slow
     def test_mosaic_knob_variants_bit_equal(self, monkeypatch, env):
         """The Mosaic experiment knobs (grid order, dimension
         semantics, VMEM cap — swept on chip by chip_campaign2 step 5)
@@ -273,6 +275,7 @@ class TestPallasKernel:
         scale = np.abs(exact).max()
         assert np.abs(got - exact).max() < 1e-4 * scale
 
+    @pytest.mark.slow
     def test_v1_impl_matches_v2(self, monkeypatch):
         """TPUDAS_PALLAS_IMPL=v1 (the proven-on-hardware VPU kernel)
         agrees with the default v2 MXU kernel in interpret mode."""
@@ -413,6 +416,7 @@ class TestStageEngines:
         # 'auto' resolves by backend: CPU under the test conftest
         assert set(stage_engines(plan, 128, 2048)) == {"xla"}
 
+    @pytest.mark.slow
     def test_lfproc_engine_counts_ground_truth(self, tmp_path):
         """LFProc.engine_counts reports what actually ran, without the
         log handler — config 'auto' on CPU runs cascade-xla windows."""
@@ -443,6 +447,7 @@ class TestStageEngines:
 
 
 class TestPallasFallback:
+    @pytest.mark.slow
     def test_lfproc_catches_silently_wrong_pallas_numbers(
         self, tmp_path, monkeypatch, capsys
     ):
@@ -586,6 +591,7 @@ class TestPallasFallback:
         assert "falling back to the XLA" in capsys.readouterr().out
 
 
+    @pytest.mark.slow
     def test_lfproc_falls_back_to_v1_impl(self, tmp_path, monkeypatch,
                                           capsys):
         """When only the v2 kernel body fails, the engine continues on

@@ -258,6 +258,7 @@ class TestPollJitter:
 
 
 class TestFleetByteIdentity:
+    @pytest.mark.slow
     def test_three_streams_match_single_stream_controls(self, tmp_path):
         """The acceptance core, in-process: a fleet of 3 streams
         (distinct content per stream, one mid-run feed) produces
@@ -316,6 +317,7 @@ class TestFleetByteIdentity:
 
 
 class TestFleetFairness:
+    @pytest.mark.slow
     def test_stalled_spool_cannot_starve_the_rest(self, tmp_path):
         """One stream's index updates stall (an NFS-slow spool); the
         deficit round-robin serves the healthy streams first in every
@@ -406,6 +408,7 @@ class TestFleetCrashResume:
     @pytest.mark.parametrize(
         "site,at", [("carry.save", 2), ("round.body", 5)]
     )
+    @pytest.mark.slow
     def test_ki_mid_fleet_resumes_byte_identical(
         self, tmp_path, site, at
     ):
@@ -497,6 +500,7 @@ class TestAuditFleet:
 
         assert load_carry(os.path.join(root, "a")) is not None
 
+    @pytest.mark.slow
     def test_fsck_cli_fleet_flag(self, tmp_path):
         root = str(tmp_path / "root")
         src = str(tmp_path / "src")

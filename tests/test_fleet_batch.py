@@ -126,6 +126,7 @@ def _pyramid_shas(folder) -> dict:
 class TestStackedCascadeOps:
     # both resolved stacked engines must stay in the matrix — the
     # tools/check_engines.py lint walks this file for the literals
+    @pytest.mark.slow
     @pytest.mark.parametrize("engine", ["xla", "fused-xla"])
     def test_mixed_width_multi_round_byte_identity(self, engine):
         """Ragged packing (widths 5/8/3) over 3 carry-fed rounds: every
@@ -161,6 +162,7 @@ class TestStackedCascadeOps:
                 for a, bb in zip(stacked_c[i], solo_c[i]):
                     assert np.array_equal(np.asarray(a), np.asarray(bb))
 
+    @pytest.mark.slow
     def test_quantized_int16_stacked(self):
         """A stacked int16 wave with a shared qscale dequantizes
         in-kernel, byte-identical to the solo quantized path."""
@@ -560,6 +562,7 @@ def _assert_streams_match_controls(tmp_path, root, pyramid=True,
 
 
 class TestFleetBatched:
+    @pytest.mark.slow
     def test_mixed_width_byte_identity_and_metrics(self, tmp_path):
         """3 mixed-width streams (6/10/6 ch) through the batched
         scheduler: every dispatch stacks (ragged packing), outputs and
@@ -614,6 +617,7 @@ class TestFleetBatched:
         eng2 = FleetEngine(root, specs, sleep_fn=lambda _s: None)
         assert eng2.batched is False
 
+    @pytest.mark.slow
     def test_fault_mid_round_shrinks_batch_not_fleet(self, tmp_path):
         """A stream faulting mid-round drops out of its batch group
         and parks; the surviving members' outputs stay byte-identical
@@ -652,6 +656,7 @@ class TestFleetBatched:
             tmp_path, root, sids=("s1",), feed_more=False
         )
 
+    @pytest.mark.slow
     def test_ki_mid_batched_fleet_resumes_byte_identical(self, tmp_path):
         """KeyboardInterrupt mid-round under batched execution (the
         in-process stand-in for SIGKILL; tools/crash_drill.py

@@ -73,6 +73,7 @@ def _blocks(plan, seed=0, n_ch=5, nan_gap=False):
 class TestFusedOps:
     @pytest.mark.parametrize("fs,ratio", PLANS)
     @pytest.mark.parametrize("nan_gap", [False, True])
+    @pytest.mark.slow
     def test_fused_xla_byte_identical(self, fs, ratio, nan_gap):
         """The fused scan replays the per-stage arithmetic chunk by
         chunk: outputs AND every carry leaf byte-identical to the
@@ -86,6 +87,7 @@ class TestFusedOps:
             np.testing.assert_array_equal(a, b)
 
     @pytest.mark.parametrize("fs,ratio", PLANS)
+    @pytest.mark.slow
     def test_fused_pallas_pinned_tolerance(self, fs, ratio):
         """The v3 kernel (interpret mode on CPU = exact f32 dots)
         matches the reference within PALLAS_RTOL, outputs and carry —
@@ -243,6 +245,7 @@ class TestKnobFingerprint:
 
 @pytest.mark.usefixtures("cpu_mesh4")
 class TestFusedMesh:
+    @pytest.mark.slow
     def test_mesh_fused_byte_identical(self, cpu_mesh4):
         """4-device CPU-mesh equivalence: the fused step under a
         channel mesh == single-device fused == reference cascade,
@@ -342,6 +345,7 @@ class TestFusedRealtime:
             np.asarray(merged[0].coords["time"]),
         )
 
+    @pytest.mark.slow
     def test_driver_fused_matches_cascade(self, source, tmp_path,
                                           fused_env):
         """Full realtime driver under engine='fused': outputs
@@ -399,6 +403,7 @@ class TestFusedRealtime:
 
     @pytest.mark.parametrize("first,second", [("cascade", "fused"),
                                               ("fused", "cascade")])
+    @pytest.mark.slow
     def test_driver_crossover_both_directions(self, source, tmp_path,
                                               first, second, fused_env):
         """Resume a cascade carry under fused and vice versa: the

@@ -272,6 +272,7 @@ class TestPrefetcherSemantics:
 
 class TestAsyncSyncIdentity:
     @pytest.mark.parametrize("engine", ["auto", "fft"])
+    @pytest.mark.slow
     def test_outputs_and_carry_identical(self, tmp_path, monkeypatch,
                                          engine):
         states = {}
@@ -284,6 +285,7 @@ class TestAsyncSyncIdentity:
             states[mode] = _folder_state(out)
         assert states["sync"] == states["async"]
 
+    @pytest.mark.slow
     def test_int16_spool_identical(self, tmp_path, monkeypatch):
         states = {}
         for mode, depth in (("sync", "0"), ("async", "3")):
@@ -298,6 +300,7 @@ class TestAsyncSyncIdentity:
             states[mode] = _folder_state(out)
         assert states["sync"] == states["async"]
 
+    @pytest.mark.slow
     def test_fused_mesh_smoke(self, tmp_path, monkeypatch):
         """The tier-1 acceptance smoke: async == sync on a 4-way CPU
         mesh with engine='fused' over a raw-int16 spool."""
@@ -345,6 +348,7 @@ class TestInt16InKernelDequant:
 
     @pytest.mark.parametrize("engine", ["auto", "fused-xla"])
     @pytest.mark.parametrize("mesh_n", [0, 4])
+    @pytest.mark.slow
     def test_cascade_stream_bitexact(self, block, engine, mesh_n):
         from tpudas.ops.fir import (
             cascade_decimate_stream,
@@ -521,6 +525,7 @@ class TestGapAndNoProgress:
 
 
 class TestPrefetchCrashEquivalence:
+    @pytest.mark.slow
     def test_ki_kill_at_prefetch_resumes_identically(self, tmp_path,
                                                      monkeypatch):
         from tpudas.resilience.faults import (

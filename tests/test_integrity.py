@@ -818,6 +818,7 @@ class TestResourceDegradation:
 
 
 class TestCrashDrill:
+    @pytest.mark.slow
     def test_smoke_seeded_kills_resume_clean(self):
         """Tier-1 smoke: 2 seeded SIGKILL cycles, cascade engine,
         pyramid on — audit clean, outputs + pyramid byte-identical to
@@ -832,6 +833,7 @@ class TestCrashDrill:
         assert rep["pyramid_match"], rep
         assert rep["ok"]
 
+    @pytest.mark.slow
     def test_smoke_mesh_drill_sharded_path(self):
         """Tier-1 smoke of the --mesh drill (ISSUE 7): a seeded
         SIGKILL cycle on the channel-sharded cascade ends audit-clean
@@ -847,6 +849,7 @@ class TestCrashDrill:
         assert rep["detect_match"], rep
         assert rep["ok"]
 
+    @pytest.mark.slow
     def test_smoke_fused_mesh_drill(self):
         """Tier-1 smoke of the fused-engine drill leg (ISSUE 10): a
         seeded SIGKILL cycle with ``engine="fused"`` on the
@@ -865,6 +868,7 @@ class TestCrashDrill:
         assert rep["detect_match"], rep
         assert rep["ok"]
 
+    @pytest.mark.slow
     def test_smoke_async_ingest_drill(self):
         """Tier-1 smoke of the --async-ingest drill leg (ISSUE 15): a
         seeded SIGKILL cycle with the prefetch pipeline on (drilled
@@ -907,6 +911,7 @@ class TestCrashDrill:
 
 
 class TestBackfillDrill:
+    @pytest.mark.slow
     def test_smoke_two_workers_two_kills(self):
         """Tier-1 smoke of the cluster-backfill chaos drill
         (ISSUE 12): 2 worker processes against one queue, 2 seeded

@@ -141,6 +141,7 @@ class TestJoint:
         with pytest.raises(ValueError, match="edge_buff_size"):
             lfp.process_time_range(T1, T2)
 
+    @pytest.mark.slow
     def test_int16_payload_matches_f32(self, tmp_path):
         outs = {}
         for label, wk in (
@@ -166,6 +167,7 @@ class TestJoint:
         # int16 quantization error bound: ~scale/2 per sample, averaged
         assert np.abs(outs["f32"] - outs["i16"]).max() < 2e-3 * scale + 1e-3
 
+    @pytest.mark.slow
     def test_mesh_run_matches_single_device(self, raw_dir, tmp_path):
         from tpudas.parallel.mesh import make_mesh
 
@@ -229,6 +231,7 @@ def test_config5_width_50k_channels(tmp_path):
         assert np.isfinite(p.host_data()).all()
 
 
+@pytest.mark.slow
 def test_window_dp_carries_rolling_product(tmp_path):
     """The window-DP batched path emits the rolling product too (the
     per-window hook is bypassed; the DP flush loop calls it), with

@@ -435,6 +435,7 @@ class TestEndToEnd:
         assert t["device_s"] > 0 and t["write_s"] > 0
         assert all(v >= 0 for v in t.values())
 
+    @pytest.mark.slow
     def test_trace_dir_writes_profile(self, spool_dir, tmp_path,
                                       monkeypatch):
         # TPUDAS_TRACE_DIR captures a jax.profiler device trace of the

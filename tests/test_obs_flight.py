@@ -472,6 +472,7 @@ def backfill_root(tmp_path_factory):
 
 
 class TestRollup:
+    @pytest.mark.slow
     def test_fleet_rollup_over_4_stream_run(self, fleet_root):
         roll = fleet_rollup(fleet_root)
         assert sorted(roll["streams"]) == [f"s{i:02d}" for i in range(4)]
@@ -484,6 +485,7 @@ class TestRollup:
             assert entry["flight"]["last_round"] >= 1
             assert sorted(entry["flight"]["phases"]) == sorted(PHASES)
 
+    @pytest.mark.slow
     def test_backfill_rollup_after_2_worker_run(self, backfill_root):
         roll = backfill_rollup(backfill_root)
         assert roll["status"] == "done"

@@ -179,6 +179,7 @@ class TestRolling:
             a.host_data(), b.host_data(), atol=1e-4, equal_nan=True
         )
 
+    @pytest.mark.slow
     def test_std_matches_pandas(self):
         from tpudas.core.units import s as sec
 

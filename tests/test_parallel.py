@@ -72,6 +72,7 @@ class TestShardedPipeline:
         return filt[halo : halo + T : ratio]
 
     @pytest.mark.parametrize("time_shards", [1, 2, 4])
+    @pytest.mark.slow
     def test_matches_interior_of_unsharded(self, time_shards):
         T, C, ratio, halo = 4000, 16, 10, 200
         data = _signal(T, C, self.fs, seed=1)
@@ -136,6 +137,7 @@ class TestShardedCascade:
         return design_cascade(fs, ratio, 0.45, 4)
 
     @pytest.mark.parametrize("time_shards", [1, 2, 4])
+    @pytest.mark.slow
     def test_bit_equal_to_single_device(self, time_shards):
         from tpudas.ops.fir import cascade_decimate
         from tpudas.parallel.pipeline import sharded_cascade_decimate
@@ -159,6 +161,7 @@ class TestShardedCascade:
         x = _signal(600, 4, 100.0)
         assert sharded_cascade_decimate(mesh, x, plan, 10, 8) is None
 
+    @pytest.mark.slow
     def test_window_dp_matches_per_window(self):
         """batched_cascade_decimate (window DP + channel sharding) ==
         stacked per-window cascade_decimate, bit for bit."""
@@ -379,6 +382,7 @@ class TestShardedCascade:
         ref = np.asarray(cascade_decimate(stack[2], plan, 150, 80, "xla"))
         assert np.array_equal(out[2], ref)
 
+    @pytest.mark.slow
     def test_window_dp_quantized(self):
         from tpudas.ops.fir import cascade_decimate
         from tpudas.parallel.batch import batched_cascade_decimate
@@ -456,6 +460,7 @@ class TestLFProcMesh:
         "time_shards,engine",
         [(1, "auto"), (2, "auto"), (4, "auto"), (1, "fft"), (2, "fft")],
     )
+    @pytest.mark.slow
     def test_sharded_files_byte_identical(
         self, src, tmp_path, time_shards, engine
     ):
@@ -802,6 +807,7 @@ class TestShardedStreamOps:
     between calls, and trim the pad-and-mask columns on output."""
 
     @pytest.mark.parametrize("n_ch", [16, 10, 3])
+    @pytest.mark.slow
     def test_cascade_stream_bit_equal_and_resident(self, n_ch):
         from jax.sharding import PartitionSpec as P
 
@@ -836,6 +842,7 @@ class TestShardedStreamOps:
                 assert leaf.shape[1] == n_ch + (-n_ch % 4)
 
     @pytest.mark.parametrize("n_ch", [16, 10])
+    @pytest.mark.slow
     def test_fft_stream_bit_equal_and_resident(self, n_ch):
         from tpudas.ops.filter import (
             fft_pass_filter_stream,
@@ -1048,6 +1055,7 @@ class TestShardedRealtimeEquivalence:
 
     # --- the acceptance tests ------------------------------------------
 
+    @pytest.mark.slow
     def test_sharded_run_byte_identical(self, tmp_path, cpu_mesh4,
                                         monkeypatch):
         """mesh=Mesh and TPUDAS_MESH=4 runs == the single-device run:
@@ -1076,6 +1084,7 @@ class TestShardedRealtimeEquivalence:
             tmp_path / "out_single", tmp_path / "out_env"
         )
 
+    @pytest.mark.slow
     def test_sharded_fft_engine_byte_identical(self, tmp_path, cpu_mesh4):
         outs = {}
         for name, mesh in (("single", None), ("mesh", cpu_mesh4)):
@@ -1093,6 +1102,7 @@ class TestShardedRealtimeEquivalence:
             self._carry_state(outs["mesh"]),
         )
 
+    @pytest.mark.slow
     def test_carry_save_cadence(self, tmp_path, cpu_mesh4):
         """TPUDAS_CARRY_SAVE_EVERY > 1 skips the per-round gather+save
         (the steady round keeps the pytree on-device) and the clean
@@ -1120,6 +1130,7 @@ class TestShardedRealtimeEquivalence:
             self._carry_state(tmp_path / "out_cadence"),
         )
 
+    @pytest.mark.slow
     def test_carry_is_layout_independent_across_restarts(
         self, tmp_path, cpu_mesh4
     ):

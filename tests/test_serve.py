@@ -503,6 +503,7 @@ class TestHTTP:
             np.save(buf, np.ascontiguousarray(wf_oracle.data))
             assert served_wf == buf.getvalue()
 
+    @pytest.mark.slow
     def test_healthz_serves_live_health_json(self, streamed):
         _, out = streamed
         on_disk = read_health(out)
@@ -730,6 +731,7 @@ class TestToolingLint:
 
 
 class TestServePoolRespawn:
+    @pytest.mark.slow
     def test_dead_worker_is_respawned(self, streamed):
         """ISSUE 12 satellite: a SIGKILLed data-plane worker is
         respawned by the supervision loop (bounded restarts, counted)
@@ -769,6 +771,7 @@ class TestServePoolRespawn:
             ).read().decode()
             assert "tpudas_serve_pool_worker_restarts_total" in body
 
+    @pytest.mark.slow
     def test_restarts_are_bounded(self, tmp_path):
         """A worker that can never come up stops being respawned
         after max_restarts (the pool reports degraded, not a spawn

@@ -1,0 +1,559 @@
+"""tpudas.store: the object-store tile plane (ISSUE 18).
+
+Backend contract (posix + fake through one parametrized surface),
+scripted fault injection (5xx, lost response, torn upload, offline),
+idempotency-aware retry with lost-CAS token-re-read recovery, the NVMe
+read-through cache's stale-but-verified degradation ladder, and the
+pyramid publisher / remote reader — including the race-matrix legs
+that live at this layer: lost conditional put converging exactly-once,
+and cache poisoning after a generation-bump CAS of the manifest.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from tpudas.obs.registry import MetricsRegistry, use_registry
+from tpudas.serve.tiles import (
+    MANIFEST_FILENAME,
+    TileStore,
+    rebuild_pyramid,
+    sync_pyramid,
+)
+from tpudas.store import (
+    CASConflictError,
+    FakeObjectStore,
+    FaultInjector,
+    FaultRule,
+    ObjectNotFoundError,
+    PosixStore,
+    PyramidPublisher,
+    ReadThroughCache,
+    RemotePyramid,
+    RetryingStore,
+    StoreError,
+    StoreNetworkError,
+    store_from_url,
+    token_of,
+)
+from tpudas.testing import make_synthetic_spool
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _registry():
+    reg = MetricsRegistry()
+    return reg
+
+
+@pytest.fixture(params=["posix", "fake"])
+def backend(request, tmp_path):
+    if request.param == "posix":
+        return PosixStore(str(tmp_path / "store"))
+    return FakeObjectStore()
+
+
+class TestContract:
+    def test_roundtrip_and_tokens(self, backend):
+        token = backend.put("a/b/obj.bin", b"payload")
+        assert token == backend.token_for(b"payload")
+        data, token2 = backend.get("a/b/obj.bin")
+        assert data == b"payload" and token2 == token
+        assert backend.head("a/b/obj.bin") == token
+        assert backend.exists("a/b/obj.bin")
+        assert backend.head("a/b/missing") is None
+        with pytest.raises(ObjectNotFoundError):
+            backend.get("a/b/missing")
+
+    def test_list_is_prefix_scoped_and_sorted(self, backend):
+        for key in ("p/z", "p/a", "p/sub/x", "q/other"):
+            backend.put(key, key.encode())
+        assert backend.list("p") == ["p/a", "p/sub/x", "p/z"]
+        assert backend.list("p/sub") == ["p/sub/x"]
+        assert backend.list("p/su") == []  # prefix is path-segment-wise
+        assert "q/other" in backend.list()
+
+    def test_delete_is_idempotent(self, backend):
+        backend.put("k", b"x")
+        assert backend.delete("k") is True
+        assert backend.delete("k") is False
+        assert backend.head("k") is None
+
+    def test_bad_keys_refused(self, backend):
+        for key in ("", "/abs", "../up", "a/../../b", "a\\b"):
+            with pytest.raises(StoreError):
+                backend.put(key, b"x")
+
+    def test_put_if_needs_exactly_one_precondition(self, backend):
+        with pytest.raises(StoreError):
+            backend.put_if("k", b"x")
+        with pytest.raises(StoreError):
+            backend.put_if("k", b"x", if_token="t", if_absent=True)
+
+    def test_create_only_cas(self, backend):
+        token = backend.put_if("lease", b"mine", if_absent=True)
+        assert token == backend.token_for(b"mine")
+        with pytest.raises(CASConflictError):
+            backend.put_if("lease", b"rival", if_absent=True)
+        assert backend.get("lease")[0] == b"mine"
+
+    def test_if_match_cas(self, backend):
+        t1 = backend.put("m", b"v1")
+        t2 = backend.put_if("m", b"v2", if_token=t1)
+        assert backend.get("m") == (b"v2", t2)
+        # the stale token now loses, and the object is untouched
+        with pytest.raises(CASConflictError):
+            backend.put_if("m", b"v3", if_token=t1)
+        assert backend.get("m")[0] == b"v2"
+        # CAS against a missing object also loses
+        with pytest.raises(CASConflictError):
+            backend.put_if("absent", b"x", if_token=t1)
+
+    def test_token_formula(self):
+        assert token_of(b"") == "00000000-0"
+        tok = token_of(b"abc")
+        crc, _, length = tok.partition("-")
+        assert len(crc) == 8 and length == "3"
+
+
+class TestPosix:
+    def test_tmp_files_invisible_but_listed_as_uploads(self, tmp_path):
+        store = PosixStore(str(tmp_path))
+        store.put("s/real", b"ok")
+        # a crashed writer's tmp debris, planted directly
+        debris = tmp_path / "s" / "half.tmp.999"
+        debris.write_bytes(b"partial")
+        assert store.list("s") == ["s/real"]
+        assert store.list_uploads("s") == ["s/half.tmp.999"]
+        assert store.abort_upload("s/half.tmp.999") is True
+        assert store.list_uploads("s") == []
+        assert store.abort_upload("s/real") is False  # not a tmp name
+        assert store.get("s/real")[0] == b"ok"
+
+
+class TestFakeFaults:
+    def test_unavailable_fires_before_apply(self):
+        store = FakeObjectStore(FaultInjector(
+            FaultRule(kind="unavailable", op="put", match="victim"),
+        ))
+        with pytest.raises(StoreNetworkError):
+            store.put("victim", b"x")
+        assert store.head("victim") is None  # nothing applied
+        store.put("victim", b"x")  # rule window passed
+        assert store.get("victim")[0] == b"x"
+
+    def test_lost_fires_after_apply(self):
+        store = FakeObjectStore(FaultInjector(
+            FaultRule(kind="lost", op="put", match="victim"),
+        ))
+        with pytest.raises(StoreNetworkError):
+            store.put("victim", b"x")
+        # the write LANDED; only the response was dropped
+        assert store.get("victim")[0] == b"x"
+
+    def test_torn_upload_leaves_debris_not_objects(self):
+        store = FakeObjectStore(FaultInjector(
+            FaultRule(kind="torn", op="put", match="victim"),
+        ))
+        with pytest.raises(StoreNetworkError):
+            store.put("s/victim", b"x")
+        assert store.list("s") == []  # readers never see partials
+        assert store.list_uploads("s") == ["s/victim"]
+        assert store.abort_upload("s/victim") is True
+        assert store.list_uploads() == []
+
+    def test_offline_fails_everything(self):
+        store = FakeObjectStore()
+        store.put("k", b"x")
+        store.injector.set_offline(True)
+        for call in (
+            lambda: store.get("k"),
+            lambda: store.head("k"),
+            lambda: store.put("k2", b"y"),
+            lambda: store.list(),
+        ):
+            with pytest.raises(StoreNetworkError):
+                call()
+        store.injector.set_offline(False)
+        assert store.get("k")[0] == b"x"
+
+    def test_latency_rule_sleeps(self):
+        slept = []
+        inj = FaultInjector(
+            FaultRule(kind="latency", op="get", seconds=0.25),
+            sleep_fn=slept.append,
+        )
+        store = FakeObjectStore(inj)
+        store.put("k", b"x")
+        store.get("k")
+        assert slept == [0.25]
+
+    def test_rule_hit_window(self):
+        store = FakeObjectStore(FaultInjector(
+            FaultRule(kind="unavailable", op="get", at=2, times=2),
+        ))
+        store.put("k", b"x")
+        store.get("k")  # hit 1: clean
+        for _ in range(2):  # hits 2-3: fire
+            with pytest.raises(StoreNetworkError):
+                store.get("k")
+        store.get("k")  # hit 4: clean again
+
+
+class TestRetry:
+    def _wrapped(self, *rules):
+        sleeps = []
+        store = RetryingStore(
+            FakeObjectStore(FaultInjector(*rules)),
+            sleep_fn=sleeps.append,
+        )
+        return store, sleeps
+
+    def test_blind_retry_rides_out_a_5xx_storm(self):
+        store, sleeps = self._wrapped(
+            FaultRule(kind="unavailable", op="put", times=3),
+        )
+        with use_registry(_registry()) as reg:
+            assert store.put("k", b"x") == token_of(b"x")
+            assert reg.counter(
+                "tpudas_store_retries_total", "", labelnames=("op",)
+            ).value(op="put") == 3
+        assert len(sleeps) == 3
+        # capped-exponential backoff: non-decreasing, bounded
+        assert sleeps == sorted(sleeps)
+        assert all(0 < s <= store.policy.max_delay for s in sleeps)
+
+    def test_patience_runs_out(self):
+        store, _ = self._wrapped(
+            FaultRule(kind="unavailable", op="get", times=99),
+        )
+        store.inner.put("k", b"x")
+        with pytest.raises(StoreNetworkError):
+            store.get("k")
+
+    def test_lost_put_converges(self):
+        store, _ = self._wrapped(FaultRule(kind="lost", op="put"))
+        assert store.put("k", b"x") == token_of(b"x")
+        assert store.inner.get("k")[0] == b"x"
+
+    def test_lost_cas_recovered_by_token_reread(self):
+        """The lost-conditional-put leg of the race matrix: the CAS
+        applies, the response drops, and the retry layer must confirm
+        its OWN write landed instead of re-issuing (which would
+        conflict against itself and miscount a success as a lost
+        race)."""
+        store, sleeps = self._wrapped(FaultRule(kind="lost", op="cas"))
+        with use_registry(_registry()) as reg:
+            token = store.put_if("marker", b"mine", if_absent=True)
+            assert token == token_of(b"mine")
+            assert reg.counter(
+                "tpudas_store_cas_recovered_total", ""
+            ).value() == 1
+        assert store.inner.get("marker")[0] == b"mine"
+        assert sleeps == []  # recovery is one head, no backoff
+        # and the marker still refuses a second writer: exactly-once
+        with pytest.raises(CASConflictError):
+            store.put_if("marker", b"rival", if_absent=True)
+
+    def test_lost_cas_with_unreachable_reread_still_recovers(self):
+        """Worst case: the response drops AND the confirm head fails.
+        The eventual CASConflictError carrying our own token is the
+        write confirming itself."""
+        store, _ = self._wrapped(
+            FaultRule(kind="lost", op="cas"),
+            FaultRule(kind="unavailable", op="head", times=1),
+        )
+        assert store.put_if(
+            "marker", b"mine", if_absent=True
+        ) == token_of(b"mine")
+        assert store.inner.get("marker")[0] == b"mine"
+
+    def test_genuine_conflict_never_retried(self):
+        store, sleeps = self._wrapped()
+        store.put("m", b"theirs")
+        with pytest.raises(CASConflictError):
+            store.put_if("m", b"mine", if_absent=True)
+        assert sleeps == []
+        assert store.inner.get("m")[0] == b"theirs"
+
+    def test_store_from_url_fake_is_shared_per_tag(self):
+        a = store_from_url("fake:test-shared")
+        b = store_from_url("fake:test-shared", retry=False)
+        assert isinstance(a, RetryingStore)
+        a.put("k", b"x")
+        assert b.get("k")[0] == b"x"
+        assert a.inner is b
+
+
+class _CountingStore:
+    """Forwarding wrapper that tallies ops — what the cache tests use
+    to prove which calls did (not) reach the cold tier."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.calls = {"get": 0, "head": 0}
+
+    def get(self, key):
+        self.calls["get"] += 1
+        return self.inner.get(key)
+
+    def head(self, key):
+        self.calls["head"] += 1
+        return self.inner.head(key)
+
+
+class TestCache:
+    def test_miss_then_hit_then_freshness(self, tmp_path):
+        remote = _CountingStore(FakeObjectStore())
+        remote.inner.put("k", b"v1")
+        cache = ReadThroughCache(str(tmp_path / "c"))
+        assert cache.get_through(remote, "k") == (b"v1", token_of(b"v1"))
+        assert remote.calls == {"get": 1, "head": 0}
+        # hit: one freshness head, no get
+        assert cache.get_through(remote, "k")[0] == b"v1"
+        assert remote.calls == {"get": 1, "head": 1}
+        # the object moved; the probe notices and refetches
+        remote.inner.put("k", b"v2")
+        assert cache.get_through(remote, "k")[0] == b"v2"
+        assert remote.calls["get"] == 2
+
+    def test_immutable_skips_the_probe(self, tmp_path):
+        remote = _CountingStore(FakeObjectStore())
+        remote.inner.put("t", b"tile")
+        cache = ReadThroughCache(str(tmp_path / "c"))
+        cache.get_through(remote, "t", immutable=True)
+        cache.get_through(remote, "t", immutable=True)
+        assert remote.calls == {"get": 1, "head": 0}
+
+    def test_stale_but_verified_when_cold_tier_down(self, tmp_path):
+        store = FakeObjectStore()
+        store.put("k", b"warm")
+        cache = ReadThroughCache(str(tmp_path / "c"))
+        cache.get_through(store, "k")
+        store.injector.set_offline(True)
+        data, _tok = cache.get_through(store, "k")
+        assert data == b"warm"
+        assert cache.degraded()
+        snap = cache.snapshot()
+        assert snap["degraded"] and snap["stale_served"] == 1
+        # a key never cached has nothing verified to serve
+        with pytest.raises(StoreNetworkError):
+            cache.get_through(store, "never-seen")
+        store.injector.set_offline(False)
+        cache.get_through(store, "k")
+        assert not cache.degraded()
+
+    def test_corrupt_entry_deleted_not_served(self, tmp_path):
+        store = FakeObjectStore()
+        store.put("k", b"good-bytes")
+        cache = ReadThroughCache(str(tmp_path / "c"))
+        cache.get_through(store, "k")
+        # flip payload bits behind the cache's back
+        (entry,) = [
+            p for p in (tmp_path / "c").iterdir()
+            if p.name.endswith(".obj")
+        ]
+        blob = bytearray(entry.read_bytes())
+        blob[-1] ^= 0xFF
+        entry.write_bytes(bytes(blob))
+        store.injector.set_offline(True)
+        with pytest.raises(StoreNetworkError):
+            cache.get_through(store, "k")  # corrupt ≠ servable
+        store.injector.set_offline(False)
+        assert cache.get_through(store, "k")[0] == b"good-bytes"
+
+    def test_lru_eviction_by_bytes(self, tmp_path):
+        store = FakeObjectStore()
+        for i in range(4):
+            store.put(f"k{i}", bytes([i]) * 100)
+        cache = ReadThroughCache(str(tmp_path / "c"), max_bytes=250)
+        for i in range(4):
+            cache.get_through(store, f"k{i}")
+        snap = cache.snapshot()
+        assert snap["entries"] == 2 and snap["bytes"] <= 250
+
+    def test_invalidate_prefix(self, tmp_path):
+        store = FakeObjectStore()
+        for key in ("s/a", "s/b", "other/c"):
+            store.put(key, b"x")
+        cache = ReadThroughCache(str(tmp_path / "c"))
+        for key in ("s/a", "s/b", "other/c"):
+            cache.get_through(store, key)
+        assert cache.invalidate_prefix("s") == 2
+        assert cache.snapshot()["entries"] == 1
+
+    def test_warm_restart_inherits_entries(self, tmp_path):
+        store = FakeObjectStore()
+        store.put("k", b"x")
+        ReadThroughCache(str(tmp_path / "c")).get_through(store, "k")
+        reborn = ReadThroughCache(str(tmp_path / "c"))
+        assert reborn.snapshot()["entries"] == 1
+        counting = _CountingStore(store)
+        reborn.get_through(counting, "k", immutable=True)
+        assert counting.calls == {"get": 0, "head": 0}
+
+
+FS = 50.0
+T0 = "2023-03-22T00:00:00"
+
+
+@pytest.fixture(scope="module")
+def pyramid_folder(tmp_path_factory):
+    """A small real pyramid (one realtime run + sync) every tileplane
+    test publishes from."""
+    from tpudas.proc.streaming import run_lowpass_realtime
+
+    src = str(tmp_path_factory.mktemp("tp_src") / "a")
+    make_synthetic_spool(
+        src, n_files=6, file_duration=20.0, fs=FS, n_ch=4,
+        noise=0.01, start=np.datetime64(T0),
+    )
+    out = str(tmp_path_factory.mktemp("tp_out") / "out")
+    run_lowpass_realtime(
+        source=src, output_folder=out, start_time=T0,
+        output_sample_interval=1.0, edge_buffer=5.0,
+        process_patch_size=20, poll_interval=0.0,
+        sleep_fn=lambda _s: None, pyramid=False,
+    )
+    sync_pyramid(out, tile_len=16)
+    return out
+
+
+def _remote(store, tmp_path, name):
+    cache = ReadThroughCache(str(tmp_path / f"{name}-cache"))
+    return RemotePyramid(
+        store, "streams/a", cache, str(tmp_path / f"{name}-mirror"),
+        min_refresh_s=0.0,
+    )
+
+
+class TestTilePlane:
+    def test_publish_then_remote_read_byte_identical(
+        self, pyramid_folder, tmp_path
+    ):
+        store = FakeObjectStore()
+        pub = PyramidPublisher(store, "streams/a", pyramid_folder)
+        first = pub.publish()
+        assert first["tiles"] > 0 and first["manifest"]
+        # steady state: nothing changed, nothing moves
+        assert pub.publish() == {"tiles": 0, "manifest": False}
+
+        local = TileStore.open(pyramid_folder)
+        remote = _remote(store, tmp_path, "r1")
+        for level in range(len(local.levels)):
+            n = int(local.n(level))
+            mine = remote.read(level, 0, n, "mean")
+            theirs = local.read(level, 0, n, "mean")
+            np.testing.assert_array_equal(mine, theirs)
+
+    def test_restarted_publisher_reuploads_nothing(
+        self, pyramid_folder, tmp_path
+    ):
+        store = FakeObjectStore()
+        PyramidPublisher(store, "streams/a", pyramid_folder).publish()
+        n_objects = len(store.snapshot_keys())
+        reborn = PyramidPublisher(store, "streams/a", pyramid_folder)
+        assert reborn.publish() == {"tiles": 0, "manifest": False}
+        assert len(store.snapshot_keys()) == n_objects
+
+    def test_restarted_publisher_catches_up_on_stale_token(
+        self, pyramid_folder, tmp_path
+    ):
+        """A single stale token (our process restarted; the artifact
+        is still single-writer) is NOT split-brain: the bounded
+        re-read loop catches up and the publish lands."""
+        import shutil
+
+        work = str(tmp_path / "work")
+        shutil.copytree(pyramid_folder, work)
+        store = FakeObjectStore()
+        PyramidPublisher(store, "streams/a", work).publish()
+        manifest_key = f"streams/a/{MANIFEST_FILENAME}"
+        pub = PyramidPublisher(store, "streams/a", work)
+        pub._seed()
+        # the object moves once behind our back (our own earlier
+        # incarnation's write we never heard about) ...
+        store.put(manifest_key, b'{"generation": 0, "old": true}')
+        # ... and the local pyramid has moved on since
+        local_manifest = os.path.join(pub.tiles_dir, MANIFEST_FILENAME)
+        with open(local_manifest, "rb") as fh:
+            moved_on = fh.read() + b"\n"
+        with open(local_manifest, "wb") as fh:
+            fh.write(moved_on)
+        assert pub._publish_mutable() is True
+        assert store.get(manifest_key)[0] == moved_on
+
+    def test_second_writer_split_brain_surfaces_as_conflict(
+        self, pyramid_folder, tmp_path
+    ):
+        """A rival that keeps moving the manifest (true split-brain:
+        two live writers on one stream) must surface as
+        CASConflictError, never be papered over."""
+
+        class _RacingStore(FakeObjectStore):
+            def _put_if(self, key, data, if_token, if_absent):
+                if key.endswith(MANIFEST_FILENAME):
+                    with self._lock:
+                        prev = self._objects.get(key, b"{}")
+                        self._objects[key] = prev + b" "
+                return super()._put_if(key, data, if_token, if_absent)
+
+        store = _RacingStore()
+        pub = PyramidPublisher(store, "streams/a", pyramid_folder)
+        with pytest.raises(CASConflictError):
+            pub.publish()
+
+    def test_cache_poisoning_after_generation_bump(
+        self, pyramid_folder, tmp_path
+    ):
+        """Race-matrix leg: a rebuild re-encodes tiles under UNCHANGED
+        names and CAS-bumps the manifest generation.  A reader holding
+        pre-bump mirror/cache entries must drop them — serving the old
+        bytes against the new manifest is the poisoning case."""
+        import shutil
+
+        work = str(tmp_path / "work")
+        shutil.copytree(pyramid_folder, work)
+        store = FakeObjectStore()
+        pub = PyramidPublisher(store, "streams/a", work)
+        pub.publish()
+        remote = _remote(store, tmp_path, "r2")
+        ts = remote.open()
+        gen0 = remote._generation
+        before = remote.read(0, 0, ts.tile_len, "mean")
+        assert remote.cache.snapshot()["entries"] > 0
+
+        # rebuild with a coarser pyramid: same tile names, new bytes
+        rebuild_pyramid(work, factor=2, tile_len=16)
+        pub2 = PyramidPublisher(store, "streams/a", work)
+        pub2.publish()
+
+        remote.refresh(force=True)
+        assert remote._generation == gen0 + 1
+        assert remote.cache.snapshot()["entries"] == 0  # flushed
+        ts2 = remote.open()
+        after = remote.read(0, 0, ts2.tile_len, "mean")
+        np.testing.assert_array_equal(
+            after, TileStore.open(work).read(0, 0, ts2.tile_len)
+        )
+
+    def test_remote_survives_outage_then_recovers(
+        self, pyramid_folder, tmp_path
+    ):
+        store = FakeObjectStore()
+        PyramidPublisher(store, "streams/a", pyramid_folder).publish()
+        remote = _remote(store, tmp_path, "r3")
+        ts = remote.open()
+        warm = remote.read(0, 0, ts.tile_len, "mean")
+        store.injector.set_offline(True)
+        remote.refresh(force=True)
+        assert remote.snapshot()["stale"] is True
+        again = remote.read(0, 0, ts.tile_len, "mean")
+        np.testing.assert_array_equal(again, warm)
+        store.injector.set_offline(False)
+        remote.refresh(force=True)
+        assert remote.snapshot()["stale"] is False

@@ -54,6 +54,7 @@ def _common_interior(a, b):
 class TestCascadeStreamOps:
     @pytest.mark.parametrize("fs,ratio", [(100.0, 100), (200.0, 40),
                                           (50.0, 7)])
+    @pytest.mark.slow
     def test_stream_matches_batch_across_blocks(self, fs, ratio):
         """Concatenated streamed outputs equal the one-shot causal
         cascade after the warm-up, across uneven block boundaries."""
@@ -173,6 +174,7 @@ class TestLFProcStream:
             (1.1, 2e-3, "fft"),  # ratio 110 = 2*5*11: prime > 8
         ],
     )
+    @pytest.mark.slow
     def test_incremental_matches_batch_oracle(self, source, tmp_path, dt,
                                               tol, kind):
         params = dict(
@@ -267,6 +269,7 @@ class TestStatefulRealtime:
             if events is not None:
                 set_log_handler(None)
 
+    @pytest.mark.slow
     def test_stateful_matches_rewind_and_kills_redundancy(self, tmp_path):
         from tpudas.utils.profiling import Counters
 
@@ -409,6 +412,7 @@ class TestStatefulRealtime:
                 stateful=True,
             )
 
+    @pytest.mark.slow
     def test_rewind_write_invalidates_stale_carry(self, tmp_path):
         """A rewind-mode round over a stateful folder removes the
         persisted carry (a later stateful resume must not reconcile
@@ -482,6 +486,7 @@ class TestStatefulRealtime:
 
 
 class TestStreamBench:
+    @pytest.mark.slow
     def test_bench_reports_the_structural_win(self, tmp_path):
         """The PR's acceptance bench: >= 1.5x fewer full-rate samples
         per steady-state round, matching outputs, zero redundancy in

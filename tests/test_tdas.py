@@ -306,6 +306,7 @@ class TestSpoolIntegration:
             fast.coords["distance"], merged.coords["distance"]
         )
 
+    @pytest.mark.slow
     def test_lfproc_end_to_end_on_tdas(self, tmp_path):
         """The full chunked engine runs unchanged on a native-format
         spool and matches the dasdae-format result exactly."""
@@ -460,6 +461,7 @@ class TestWindowPlan:
         patch = tdas.assemble_window_patch(plan)
         assert patch.host_data().dtype == np.float32
 
+    @pytest.mark.slow
     def test_lfproc_device_decode_matches_host_decode(self, tmp_path):
         """The engine on a uniform-int16 spool (device decode) produces
         byte-identical output to the same engine fed host-decoded f32

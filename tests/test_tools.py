@@ -88,6 +88,7 @@ class TestAnalyzeCampaign:
 
 
 class TestKernelBench:
+    @pytest.mark.slow
     def test_quick_bench_reports_fused_contract(self, tmp_path):
         """Tier-1 smoke of tools/kernel_bench.py (ISSUE 10): the
         --quick sweep runs all three engines at the smallest width,

@@ -30,6 +30,18 @@ CLI (the acceptance drill — BENCH_pr12.json records a run)::
     JAX_PLATFORMS=cpu python tools/backfill_drill.py \
         [--workers 4] [--kills 6] [--shards 8] [--seed 0] [--out PATH]
 
+``--store`` (ISSUE 18) runs the drill on the OBJECT-STORE queue
+(:mod:`tpudas.backfill.objqueue`) instead: worker subprocesses share
+NOTHING but a ``file://`` object store (each drains into a private
+scratch directory), SIGKILLs land the same way, every worker's store
+plane additionally rides a scripted network-fault storm
+(``store.op`` raises absorbed by the retry layer), and a second
+in-process leg replays the job on the fault-injected FAKE backend
+(5xx storms, lost responses, torn uploads, latency spikes) asserting
+its stitched result byte-identical to an unfaulted POSIX-store
+control.  ``audit_backfill_store`` must come back clean and the
+materialized result byte-identical to the sequential realtime run.
+
 ``tests/test_integrity.py`` runs a 2-worker/2-kill smoke in tier-1.
 """
 
@@ -363,6 +375,430 @@ def run_backfill_drill(
             log_fh.close()
 
 
+# ---------------------------------------------------------------------------
+# the object-store drill (ISSUE 18): same chaos, no shared filesystem
+
+def _store_worker_main(url: str, prefix: str, scratch: str,
+                       ready_dir: str, worker_id: str,
+                       fault: str) -> int:
+    """One object-store chaos worker: private scratch, store built
+    from the URL.  ``fault`` is either a protocol-point death
+    (``backfill.claim:2`` — an uncaught raise, i.e. the worker dying
+    there) or a network storm (``store:AT xN`` — StoreNetworkError at
+    the ``store.op`` site, absorbed by the retry layer)."""
+    from tpudas.backfill.objqueue import run_store_worker
+    from tpudas.resilience.faults import (
+        FaultPlan,
+        FaultSpec,
+        install_fault_plan,
+    )
+    from tpudas.store import StoreNetworkError, store_from_url
+
+    os.makedirs(ready_dir, exist_ok=True)
+    if fault:
+        site, _, rest = fault.partition(":")
+        at, _, times = rest.partition("x")
+        spec_kwargs = {}
+        if site == "store":
+            site = "store.op"
+            spec_kwargs["exc"] = StoreNetworkError
+        install_fault_plan(
+            FaultPlan(
+                FaultSpec(
+                    site, "raise", at=int(at or 1),
+                    times=int(times or 1), **spec_kwargs,
+                )
+            )
+        )
+    with open(os.path.join(ready_dir, worker_id + ".ready"), "w") as fh:
+        fh.write(str(os.getpid()))
+    run_store_worker(
+        store_from_url(url), prefix, scratch=scratch,
+        worker=worker_id, stitch=True, lease_ttl=LEASE_TTL,
+        idle_poll=0.1,
+    )
+    return 0
+
+
+def _spawn_store(url, prefix, scratch_root, ready_dir, worker_id,
+                 fault="", log_fh=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault(
+        "TPUDAS_COMPILE_CACHE",
+        os.path.join(os.path.dirname(scratch_root), "xla_cache"),
+    )
+    return subprocess.Popen(
+        [
+            sys.executable, os.path.abspath(__file__),
+            "--store-worker", url, prefix,
+            os.path.join(scratch_root, worker_id), ready_dir,
+            worker_id, fault,
+        ],
+        env=env,
+        stdout=log_fh if log_fh is not None else subprocess.DEVNULL,
+        stderr=subprocess.STDOUT if log_fh is not None else (
+            subprocess.DEVNULL
+        ),
+    )
+
+
+def _plan_store(store, prefix: str, src: str, n_files: int) -> dict:
+    import numpy as np
+
+    from tpudas.backfill.objqueue import plan_backfill_store
+
+    t_end = np.datetime64(T0) + np.timedelta64(
+        int(n_files * FILE_SEC * 1e9), "ns"
+    )
+    return plan_backfill_store(
+        store, prefix, src, T0, t_end, shard_seconds=SHARD_SEC,
+        output_sample_interval=DT_OUT, edge_buffer=EDGE_SEC,
+        process_patch_size=PATCH_OUT, pyramid=True, detect=True,
+        detect_operators=DETECT_OPS, ingest_limit_sec=40.0,
+    )
+
+
+def _materialize_result(store, prefix: str, dest: str) -> int:
+    """Token-verified download of the stitched result objects."""
+    from tpudas.backfill.objqueue import (
+        RESULT_MANIFEST_KEY,
+        RESULT_PREFIX,
+        StoreBackfillQueue,
+    )
+
+    queue = StoreBackfillQueue(store, prefix, worker="drill-reader")
+    manifest = queue._get_verified(queue._key(RESULT_MANIFEST_KEY))[0]
+    if manifest is None:
+        raise RuntimeError(f"no verifying result manifest under {prefix}")
+    base = queue._key(RESULT_PREFIX)
+    os.makedirs(dest, exist_ok=True)
+    n = 0
+    for rel, tok in manifest["objects"].items():
+        data, got = store.get(f"{base}/{rel}")
+        if got != tok:
+            raise RuntimeError(
+                f"result object {rel!r} token {got!r} != manifest {tok!r}"
+            )
+        path = os.path.join(dest, *rel.split("/"))
+        os.makedirs(os.path.dirname(path) or dest, exist_ok=True)
+        with open(path, "wb") as fh:
+            fh.write(data)
+        n += 1
+    return n
+
+
+def _store_overhead(store, prefix: str) -> tuple:
+    """(overhead_s, shard_wall_s) summed over the done markers."""
+    from tpudas.backfill.objqueue import (
+        DONE_PREFIX,
+        StoreBackfillQueue,
+    )
+
+    queue = StoreBackfillQueue(store, prefix, worker="drill-reader")
+    over = wall = 0.0
+    for key in store.list(queue._key(DONE_PREFIX)):
+        payload = queue._get_verified(key)[0]
+        if payload is None:
+            continue
+        over += float(payload.get("overhead_s", 0.0))
+        wall += float(payload.get("wall_s", 0.0))
+    return over, wall
+
+
+def _run_store_control(bucket: str, src: str, n_files: int,
+                       scratch: str, max_wall: float) -> str:
+    """The uninterrupted POSIX-store control: plan + 1 worker over a
+    ``file://`` store, result materialized locally.  Returns the
+    materialized result directory."""
+    from tpudas.backfill.objqueue import run_store_worker
+    from tpudas.store import store_from_url
+
+    store = store_from_url(f"file://{bucket}")
+    _plan_store(store, "job", src, n_files)
+    run_store_worker(
+        store, "job", scratch=scratch, worker="ctrl",
+        lease_ttl=LEASE_TTL, max_wall=max_wall, idle_poll=0.05,
+    )
+    dest = bucket + ".result"
+    _materialize_result(store, "job", dest)
+    return dest
+
+
+def run_store_fault_matrix(src: str, n_files: int, workdir: str,
+                           ctrl_res: str, max_wall: float) -> dict:
+    """The fake-backend fault matrix: two in-process workers drain
+    the job through a retry-wrapped fake store under scripted 5xx
+    storms, lost responses (CAS included), torn uploads, and latency
+    spikes — then the stitched result must be byte-identical to the
+    unfaulted POSIX-store control and the audit clean."""
+    import threading
+
+    from tools.crash_drill import _content_hash, _pyramid_tree
+    from tpudas.backfill.objqueue import run_store_worker
+    from tpudas.integrity.audit import audit_backfill_store
+    from tpudas.store import (
+        FakeObjectStore,
+        FaultInjector,
+        FaultRule,
+        RetryingStore,
+    )
+
+    raw = FakeObjectStore(FaultInjector(
+        # three 5xx storms scattered over the run, any op
+        FaultRule(kind="unavailable", at=5, times=3),
+        FaultRule(kind="unavailable", at=60, times=3),
+        FaultRule(kind="unavailable", at=200, times=2),
+        # lost responses on mutations, the CAS path included
+        FaultRule(kind="lost", op="cas", at=2, times=1),
+        FaultRule(kind="lost", op="cas", at=9, times=1),
+        FaultRule(kind="lost", op="put", at=20, times=1),
+        # torn uploads of shard objects (retries re-put clean)
+        FaultRule(kind="torn", op="put", match="shards/", at=4,
+                  times=1),
+        FaultRule(kind="torn", op="put", match="shards/", at=30,
+                  times=1),
+        # latency spikes on reads
+        FaultRule(kind="latency", op="get", at=3, times=4,
+                  seconds=0.02),
+    ))
+    store = RetryingStore(raw, sleep_fn=lambda _s: None)
+    _plan_store(store, "job", src, n_files)
+
+    tallies = {}
+
+    def _drain(name):
+        tallies[name] = run_store_worker(
+            store, "job",
+            scratch=os.path.join(workdir, f"fake-scratch-{name}"),
+            worker=name, max_wall=max_wall, idle_poll=0.02,
+            sleep_fn=lambda _s: None, lease_ttl=LEASE_TTL,
+        )
+
+    threads = [
+        threading.Thread(target=_drain, args=(f"fw{i}",))
+        for i in (1, 2)
+    ]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.time() - t0
+
+    report = audit_backfill_store(store, "job", repair=True)
+    res = os.path.join(workdir, "fake-result")
+    _materialize_result(store, "job", res)
+    fired = {}
+    for kind, _op, _key, _hit in raw.injector.fired:
+        fired[kind] = fired.get(kind, 0) + 1
+    return {
+        "faults_fired": fired,
+        "audit_clean": bool(report["clean"]),
+        "audit_issues": report["issues_total"],
+        "committed": sum(
+            t["committed"] + t["adopted"] for t in tallies.values()
+        ),
+        "outputs_match_posix_control": (
+            _content_hash(res) == _content_hash(ctrl_res)
+        ),
+        "pyramid_match_posix_control": (
+            _pyramid_tree(res) == _pyramid_tree(ctrl_res)
+        ),
+        "wall_s": round(wall, 3),
+    }
+
+
+def run_store_backfill_drill(
+    workers: int = 3,
+    kills: int = 4,
+    shards: int = 4,
+    seed: int = 0,
+    workdir: str | None = None,
+    log_path: str | None = None,
+    max_wall: float = 1200.0,
+) -> dict:
+    """The object-store chaos drill: worker subprocesses sharing only
+    a ``file://`` object store, SIGKILLed on a seeded schedule, with
+    protocol-point deaths and per-worker network storms injected —
+    then the audit must be clean and the result byte-identical to the
+    POSIX-store control AND the sequential realtime run; the fake
+    fault-matrix leg rides on the same archive."""
+    import numpy as np
+
+    from tools.crash_drill import (
+        _content_hash,
+        _detect_state,
+        _pyramid_tree,
+    )
+    from tpudas.backfill.objqueue import StoreBackfillQueue
+    from tpudas.integrity.audit import audit_backfill_store
+    from tpudas.store import store_from_url
+
+    workers = int(workers)
+    n_files = int(round(shards * SHARD_SEC / FILE_SEC))
+    workdir = workdir or tempfile.mkdtemp(
+        prefix=f"store_drill_w{workers}_"
+    )
+    src = os.path.join(workdir, "src")
+    bucket = os.path.join(workdir, "bucket")
+    ctrl_bucket = os.path.join(workdir, "bucket_ctrl")
+    scratch_root = os.path.join(workdir, "scratch")
+    ready_dir = os.path.join(workdir, ".workers")
+    seq = os.path.join(workdir, "seq")
+    url = f"file://{bucket}"
+    prefix = "job"
+    log_fh = open(log_path, "ab") if log_path else None
+    try:
+        _build_archive(src, n_files)
+        store = store_from_url(url)
+        _plan_store(store, prefix, src, n_files)
+        t0 = time.time()
+        ctrl_res = _run_store_control(
+            ctrl_bucket, src, n_files,
+            os.path.join(workdir, "ctrl-scratch"), max_wall,
+        )
+        ctrl_wall = time.time() - t0
+        from tpudas.proc.streaming import run_lowpass_realtime
+
+        run_lowpass_realtime(
+            source=src, output_folder=seq, start_time=T0,
+            output_sample_interval=DT_OUT, edge_buffer=EDGE_SEC,
+            process_patch_size=PATCH_OUT, poll_interval=0.0,
+            sleep_fn=lambda _s: None, pyramid=True, detect=True,
+            detect_operators=DETECT_OPS,
+        )
+        rng = np.random.default_rng(seed)
+        est = max(ctrl_wall / max(shards, 1), 0.4)
+        queue = StoreBackfillQueue(store, prefix, worker="parent")
+        done_key = queue._key("result.done.json")
+        procs: dict = {}
+        spawn_i = 0
+        kills_done = 0
+        faults_injected = []
+        deadline = time.time() + max_wall
+
+        def spawn_one():
+            nonlocal spawn_i
+            wid = f"w{spawn_i:03d}"
+            fault = ""
+            if spawn_i % 3 == 1:
+                fault = f"backfill.claim:{int(rng.integers(1, 4))}"
+            elif spawn_i % 4 == 2:
+                fault = f"backfill.commit:{int(rng.integers(1, 3))}"
+            elif spawn_i % 4 == 3:
+                # a network storm this worker's retry layer must absorb
+                fault = f"store:{int(rng.integers(3, 40))}x3"
+            if fault:
+                faults_injected.append(f"{wid}={fault}")
+            procs[wid] = _spawn_store(
+                url, prefix, scratch_root, ready_dir, wid, fault,
+                log_fh,
+            )
+            spawn_i += 1
+
+        for _ in range(workers):
+            spawn_one()
+        while True:
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"store drill exceeded {max_wall}s; queue counts "
+                    f"{queue.counts()}"
+                )
+            for wid in list(procs):
+                if procs[wid].poll() is not None:
+                    del procs[wid]
+            resolved = queue.resolved()
+            stitched = store.head(done_key) is not None
+            if resolved and stitched and not procs:
+                break
+            if resolved and stitched:
+                time.sleep(0.1)
+                continue
+            if kills_done < kills and procs:
+                live_ready = [
+                    w for w in sorted(procs)
+                    if os.path.isfile(
+                        os.path.join(ready_dir, w + ".ready")
+                    )
+                ]
+                if live_ready:
+                    victim = live_ready[
+                        int(rng.integers(0, len(live_ready)))
+                    ]
+                    time.sleep(float(rng.uniform(0.05, est)))
+                    if procs[victim].poll() is None:
+                        os.kill(procs[victim].pid, signal.SIGKILL)
+                        procs[victim].wait()
+                        kills_done += 1
+                    del procs[victim]
+            while len(procs) < workers and not (resolved and stitched):
+                spawn_one()
+            time.sleep(0.05)
+        report = audit_backfill_store(store, prefix, repair=True)
+        res = os.path.join(workdir, "result")
+        _materialize_result(store, prefix, res)
+        over_s, wall_s = _store_overhead(store, prefix)
+        comp = {
+            "outputs_match_control": (
+                _content_hash(res) == _content_hash(ctrl_res)
+            ),
+            "pyramid_match_control": (
+                _pyramid_tree(res) == _pyramid_tree(ctrl_res)
+            ),
+            "detect_match_control": (
+                _detect_state(res) == _detect_state(ctrl_res)
+            ),
+            "outputs_match_sequential": (
+                _content_hash(res) == _content_hash(seq)
+            ),
+            "pyramid_match_sequential": (
+                _pyramid_tree(res) == _pyramid_tree(seq)
+            ),
+            "detect_match_sequential": (
+                _detect_state(res) == _detect_state(seq)
+            ),
+        }
+        matrix = run_store_fault_matrix(
+            src, n_files, workdir, ctrl_res, max_wall,
+        )
+        ok = bool(
+            report["clean"]
+            and not report["parked"]
+            and all(comp.values())
+            and kills_done >= min(kills, 1)
+            and matrix["audit_clean"]
+            and matrix["outputs_match_posix_control"]
+            and matrix["pyramid_match_posix_control"]
+        )
+        return {
+            "mode": "store",
+            "workers": workers,
+            "kills": kills_done,
+            "kills_requested": int(kills),
+            "shards": int(shards),
+            "seed": int(seed),
+            "spawns": spawn_i,
+            "faults_injected": faults_injected,
+            "audit_clean": bool(report["clean"]),
+            "audit_issues": report["issues_total"],
+            "parked": report["parked"],
+            **comp,
+            "fault_matrix": matrix,
+            "overhead_s": round(over_s, 4),
+            "shard_wall_s": round(wall_s, 4),
+            "overhead_fraction": (
+                round(over_s / wall_s, 5) if wall_s else None
+            ),
+            "ctrl_wall_s": round(ctrl_wall, 3),
+            "workdir": workdir,
+            "ok": ok,
+        }
+    finally:
+        if log_fh is not None:
+            log_fh.close()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--workers", type=int, default=4)
@@ -371,8 +807,15 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default=None, help="write JSON report here")
     ap.add_argument("--log", default=None, help="worker stdout log file")
+    ap.add_argument(
+        "--store", action="store_true",
+        help="drill the object-store queue (file:// chaos leg + "
+             "fault-injected fake backend leg) instead of the "
+             "shared-filesystem queue",
+    )
     args = ap.parse_args(argv)
-    rep = run_backfill_drill(
+    run = run_store_backfill_drill if args.store else run_backfill_drill
+    rep = run(
         workers=args.workers, kills=args.kills, shards=args.shards,
         seed=args.seed, log_path=args.log,
     )
@@ -387,6 +830,14 @@ def main(argv=None) -> int:
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 7 and sys.argv[1] == "--store-worker":
+        sys.exit(
+            _store_worker_main(
+                sys.argv[2], sys.argv[3], sys.argv[4],
+                sys.argv[5], sys.argv[6],
+                sys.argv[7] if len(sys.argv) > 7 else "",
+            )
+        )
     if len(sys.argv) >= 4 and sys.argv[1] == "--worker":
         sys.exit(
             _worker_main(

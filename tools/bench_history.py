@@ -56,6 +56,8 @@ _HEADLINE_PATTERNS = (
     (re.compile(r"realtime", re.I), "up"),
     (re.compile(r"rt_factor|_rt$|^rt$", re.I), "up"),
     (re.compile(r"throughput", re.I), "up"),
+    (re.compile(r"qps", re.I), "up"),
+    (re.compile(r"hit_rate", re.I), "up"),
     (re.compile(r"utilization", re.I), "up"),
     (re.compile(r"overhead", re.I), "down"),
     (re.compile(r"lag", re.I), "down"),

@@ -170,6 +170,23 @@ REQUIRED_METRICS = (
     "tpudas_devprof_compile_seconds_total",
     "tpudas_devprof_recompile_storm",
     "tpudas_devprof_utilization",
+    # object-store plane (PR 18): tools/store_bench.py reads the
+    # cache/retry counters by name, /healthz's store block surfaces
+    # the degraded flag, RESILIENCE.md's cold-tier-down runbook keys
+    # off degraded + stale_served
+    "tpudas_store_ops_total",
+    "tpudas_store_op_seconds",
+    "tpudas_store_bytes_total",
+    "tpudas_store_network_errors_total",
+    "tpudas_store_cas_conflicts_total",
+    "tpudas_store_retries_total",
+    "tpudas_store_cas_recovered_total",
+    "tpudas_store_cache_events_total",
+    "tpudas_store_cache_bytes",
+    "tpudas_store_cache_stale_served_total",
+    "tpudas_store_degraded",
+    "tpudas_store_published_tiles_total",
+    "tpudas_store_generation_invalidations_total",
 )
 REQUIRED_SPANS = (
     "serve.request",
@@ -201,6 +218,14 @@ REQUIRED_SPANS = (
     "op.stacked",
     # device telemetry plane (PR 17)
     "obs.devprof",
+    # object-store plane (PR 18)
+    "store.put",
+    "store.cas",
+    "store.get",
+    "store.head",
+    "store.delete",
+    "store.list",
+    "store.publish",
 )
 
 

@@ -21,6 +21,13 @@ Options:
                     commits, audit committed shards + the stitched
                     result (tpudas.integrity.audit.audit_backfill,
                     RESILIENCE.md "Cluster backfill")
+    --store URL     audit an OBJECT-STORE backfill job instead: the
+                    positional argument is the job prefix inside the
+                    store named by URL (file:///path, s3://bucket/...,
+                    fake:tag); classifies torn markers/leases, crashed
+                    commits, orphan objects, and torn partial uploads
+                    from list() + content-token verification
+                    (tpudas.integrity.audit.audit_backfill_store)
     --out PATH      also write the JSON report to PATH
 
 Run only while the driver is stopped: the stale-tmp sweep cannot tell
@@ -61,14 +68,34 @@ def main(argv=None) -> int:
         "--backfill", action="store_true",
         help="audit the folder as a tpudas.backfill queue root",
     )
+    ap.add_argument(
+        "--store", default=None, metavar="URL",
+        help="audit an object-store backfill job: FOLDER is the job "
+             "prefix inside this store URL",
+    )
     ap.add_argument("--out", default=None, help="write JSON report here")
     args = ap.parse_args(argv)
     if args.fleet and args.backfill:
         ap.error("--fleet and --backfill are mutually exclusive")
+    if args.store and args.fleet:
+        ap.error("--store and --fleet are mutually exclusive")
 
-    from tpudas.integrity.audit import audit, audit_backfill, audit_fleet
+    from tpudas.integrity.audit import (
+        audit,
+        audit_backfill,
+        audit_backfill_store,
+        audit_fleet,
+    )
 
-    if args.backfill:
+    if args.store:
+        from tpudas.store import store_from_url
+
+        report = audit_backfill_store(
+            store_from_url(args.store),
+            args.folder,
+            repair=not args.no_repair,
+        )
+    elif args.backfill:
         report = audit_backfill(
             args.folder,
             repair=not args.no_repair,

@@ -1,4 +1,4 @@
-"""tpudas.backfill — crash-only cluster backfill over a shared filesystem.
+"""tpudas.backfill — crash-only cluster backfill (shared FS or object store).
 
 The batch half of the paper's workload (reprocess months of archived
 spool with a new filter plan, new detect operators, or a codec
@@ -16,7 +16,11 @@ exactly-once shard commit:
 - :mod:`tpudas.backfill.stitch` — deterministic stitching of the
   committed shard outputs into a result byte-identical to a single
   sequential run (pyramid synced, detect ledger/scores recomputed
-  chunk-invariantly).
+  chunk-invariantly);
+- :mod:`tpudas.backfill.objqueue` — the same queue/worker/stitch over
+  a :mod:`tpudas.store` object store: N hosts with NO shared
+  filesystem, conditional-put leases and upload-manifest commits in
+  place of atomic renames.
 
 ``tools/backfill_drill.py`` is the chaos harness (N workers, seeded
 SIGKILLs, injected claim/commit faults); ``tools/backfill_bench.py``
@@ -24,10 +28,17 @@ records the worker-count scaling curve.  See RESILIENCE.md, "Cluster
 backfill".
 """
 
+from tpudas.backfill.objqueue import (  # noqa: F401
+    StoreBackfillQueue,
+    plan_backfill_store,
+    run_store_worker,
+    stitch_store_backfill,
+)
 from tpudas.backfill.queue import (  # noqa: F401
     BackfillQueue,
     Lease,
     LeaseLostError,
+    build_plan,
     load_plan,
     plan_backfill,
 )
@@ -38,8 +49,13 @@ __all__ = [
     "BackfillQueue",
     "Lease",
     "LeaseLostError",
+    "StoreBackfillQueue",
+    "build_plan",
     "load_plan",
     "plan_backfill",
+    "plan_backfill_store",
+    "run_store_worker",
     "run_worker",
     "stitch_backfill",
+    "stitch_store_backfill",
 ]

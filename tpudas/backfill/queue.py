@@ -79,6 +79,7 @@ __all__ = [
     "BackfillQueue",
     "Lease",
     "LeaseLostError",
+    "build_plan",
     "load_plan",
     "plan_backfill",
 ]
@@ -245,8 +246,7 @@ def default_leads(source, d_t, edge_buffer, order=None) -> tuple:
     return _grid_ceil(4 * edge, d_t), _grid_ceil(8 * edge, d_t)
 
 
-def plan_backfill(
-    root,
+def build_plan(
     source,
     t0,
     t1,
@@ -264,31 +264,12 @@ def plan_backfill(
     ingest_limit_sec: float | None = 600.0,
     **extra_config,
 ) -> dict:
-    """Write the crc-stamped plan for one backfill job and return it.
-
-    The archive slice ``[t0, t1)`` is cut into shards of
-    ``shard_seconds`` (rounded up to the output grid; the last shard
-    takes the remainder).  ``lead_seconds`` is the per-shard warm-up
-    margin (default ``2 * edge_buffer``, grid-rounded).  The remaining
-    keywords mirror the lowpass driver knobs the workers rebuild a
-    :class:`~tpudas.fleet.config.StreamConfig` from; ``pyramid`` /
-    ``detect`` are applied at STITCH time (shards themselves write
-    only output files + carry — serve/detect state near a cold shard
-    boundary would differ from the sequential run's, so it is derived
-    once, deterministically, from the stitched rows).
-
-    Raises ``FileExistsError`` when the root already holds a plan —
-    a queue is immutable once written (workers may already be
-    claiming against it).
-    """
-    root = str(root)
-    os.makedirs(root, exist_ok=True)
-    path = os.path.join(root, PLAN_FILENAME)
-    if os.path.isfile(path):
-        raise FileExistsError(
-            f"{path} already exists; a backfill plan is immutable "
-            "(make a new root to re-plan)"
-        )
+    """The pure planning step: cut ``[t0, t1)`` into shards, derive
+    the warm-up leads, and return the plan dict — no filesystem or
+    store touched (beyond probing the SOURCE archive for lead
+    derivation).  :func:`plan_backfill` persists it to a directory
+    root; the object-store queue persists the same dict as a
+    create-only object."""
     d_t = float(output_sample_interval)
     t0_ns, t1_ns = _ns(t0), _ns(t1)
     if t1_ns <= t0_ns:
@@ -330,7 +311,7 @@ def plan_backfill(
     unknown = sorted(set(config) - set(_PLAN_CONFIG_KEYS))
     if unknown:
         raise ValueError(f"unknown backfill config key(s): {unknown}")
-    plan = {
+    return {
         "version": _PLAN_VERSION,
         "source": os.path.abspath(str(source)),
         "t0_ns": t0_ns,
@@ -344,19 +325,49 @@ def plan_backfill(
         "config": config,
         "shards": shards,
     }
+
+
+def plan_backfill(root, source, t0, t1, **kwargs) -> dict:
+    """Write the crc-stamped plan for one backfill job and return it.
+
+    The archive slice ``[t0, t1)`` is cut into shards of
+    ``shard_seconds`` (rounded up to the output grid; the last shard
+    takes the remainder).  ``lead_seconds`` is the per-shard warm-up
+    margin (default derived from the cascade plan, grid-rounded).  The
+    remaining keywords mirror the lowpass driver knobs the workers
+    rebuild a :class:`~tpudas.fleet.config.StreamConfig` from (see
+    :func:`build_plan`); ``pyramid`` / ``detect`` are applied at
+    STITCH time (shards themselves write only output files + carry —
+    serve/detect state near a cold shard boundary would differ from
+    the sequential run's, so it is derived once, deterministically,
+    from the stitched rows).
+
+    Raises ``FileExistsError`` when the root already holds a plan —
+    a queue is immutable once written (workers may already be
+    claiming against it).
+    """
+    root = str(root)
+    os.makedirs(root, exist_ok=True)
+    path = os.path.join(root, PLAN_FILENAME)
+    if os.path.isfile(path):
+        raise FileExistsError(
+            f"{path} already exists; a backfill plan is immutable "
+            "(make a new root to re-plan)"
+        )
+    plan = build_plan(source, t0, t1, **kwargs)
     write_json_checksummed(path, plan, durable=True)
     for d in (SHARDS_DIRNAME, LEASES_DIRNAME, DONE_DIRNAME, PARKED_DIRNAME):
         os.makedirs(os.path.join(root, d), exist_ok=True)
     get_registry().gauge(
         "tpudas_backfill_shards", "time shards in the backfill plan"
-    ).set(len(shards))
+    ).set(len(plan["shards"]))
     log_event(
         "backfill_planned",
         root=root,
-        shards=len(shards),
-        shard_seconds=shard_sec,
-        lead_seconds=lead_sec,
-        tail_seconds=tail_sec,
+        shards=len(plan["shards"]),
+        shard_seconds=plan["shard_seconds"],
+        lead_seconds=plan["lead_seconds"],
+        tail_seconds=plan["tail_seconds"],
     )
     return plan
 

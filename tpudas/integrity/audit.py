@@ -91,7 +91,13 @@ from tpudas.obs.trace import span
 from tpudas.utils.atomicio import is_tmp_name
 from tpudas.utils.logging import log_event
 
-__all__ = ["audit", "audit_backfill", "audit_fleet", "fleet_stream_dirs"]
+__all__ = [
+    "audit",
+    "audit_backfill",
+    "audit_backfill_store",
+    "audit_fleet",
+    "fleet_stream_dirs",
+]
 
 _TILE_NAME_RE = re.compile(r"^(\d{8})\.npy$")
 # compressed pyramid tiles (tpudas.codec blobs, ISSUE 11): the crc is
@@ -974,6 +980,7 @@ _REPAIRED_ACTIONS = (
     "reset_detect",
     "truncated",
     "adopted_commit",
+    "aborted",
 )
 
 
@@ -1399,6 +1406,303 @@ def audit_backfill(root, repair: bool = True, rebuild: bool = True,
         "issues_total": len(issues) + sum(
             len(r["issues"]) for r in shard_reports.values()
         ),
+    }
+    if error is not None:
+        report["error"] = error
+    if report["issues_total"]:
+        log_event(
+            "integrity_audit_backfill",
+            root=root,
+            clean=report["clean"],
+            repaired=repaired,
+            parked=len(parked),
+        )
+    return report
+
+
+def _store_shard_check(queue, shard_id, issues, repair, clock) -> None:
+    """One shard's object-store queue state: torn/bodiless done
+    markers, torn/stale leases, crashed commits (verifying upload
+    manifest without its marker → adopt), unverifiable manifests
+    (→ removed, shard re-executes), orphan objects beyond the
+    manifest.  Everything is read through ``list()`` + token
+    verification — there is no directory to walk."""
+    from tpudas.backfill.objqueue import SHARD_MANIFEST_NAME
+    from tpudas.backfill.queue import Lease
+
+    store = queue.store
+    done_key = queue._done_key(shard_id)
+    lease_key = queue._lease_key(shard_id)
+    manifest_key = queue._manifest_key(shard_id)
+    base = queue.shard_prefix(shard_id)
+    # -- the done marker ------------------------------------------------
+    done_payload, done_token = queue._get_verified(done_key)
+    done = done_payload is not None
+    if done_token is not None and not done:
+        if repair:
+            store.delete(done_key)
+        _issue(
+            issues, "backfill_done", done_key, "torn",
+            _repair_action(repair, "removed"), "crc32 mismatch",
+        )
+    manifest = queue.shard_manifest(shard_id)
+    verified = manifest is not None and queue.manifest_verifies(shard_id)
+    if done and not verified:
+        # a marker with no verifying bytes behind it can only mislead
+        # the stitch — remove it, the shard re-executes
+        if repair:
+            store.delete(done_key)
+        _issue(
+            issues, "backfill_done", done_key, "corrupt",
+            _repair_action(repair, "removed"),
+            "done marker without a verifying upload manifest",
+        )
+        done = False
+    # -- the lease ------------------------------------------------------
+    lease_token = store.head(lease_key)
+    if lease_token is not None:
+        lease = queue.read_lease(shard_id)
+        now_ns = int(float(clock()) * 1e9)
+        if lease is None:
+            if repair:
+                store.delete(lease_key)
+            _issue(
+                issues, "backfill_lease", lease_key, "torn",
+                _repair_action(repair, "removed"), "unparseable lease",
+            )
+        elif done:
+            if repair:
+                store.delete(lease_key)
+            _issue(
+                issues, "backfill_lease", lease_key, "stale_lease",
+                _repair_action(repair, "removed"),
+                "lease outlived its shard's commit",
+            )
+        elif int(lease.get("deadline_ns", 0)) < now_ns:
+            if repair:
+                store.delete(lease_key)
+            _issue(
+                issues, "backfill_lease", lease_key, "stale_lease",
+                _repair_action(repair, "removed"),
+                f"deadline passed (worker {lease.get('worker')!r})",
+            )
+    # -- a verifying manifest without its marker ------------------------
+    if not done:
+        if verified and not queue.is_parked(shard_id):
+            # the crash window between the manifest upload and the
+            # marker put: adopt (exactly what a claiming worker does)
+            if repair:
+                queue._write_done(
+                    shard_id,
+                    Lease(shard=shard_id, token="fsck", worker="fsck"),
+                    {"adopted": True},
+                )
+                done = True
+            _issue(
+                issues, "backfill_commit", manifest_key, "torn",
+                _repair_action(repair, "adopted_commit"),
+                "verifying upload manifest without a done marker",
+            )
+        elif manifest is not None and not verified:
+            # mid-upload crash (or torn/tampered object): the manifest
+            # protects nothing — remove it so the shard re-executes
+            # cleanly over the debris (uploads are idempotent)
+            if repair:
+                store.delete(manifest_key)
+                manifest = None
+            _issue(
+                issues, "backfill_commit", manifest_key, "corrupt",
+                _repair_action(repair, "removed"),
+                "upload manifest fails token verification "
+                "(re-executes)",
+            )
+        elif (
+            manifest is None
+            and store.head(manifest_key) is not None
+        ):
+            # present but unparseable — same verdict
+            if repair:
+                store.delete(manifest_key)
+            _issue(
+                issues, "backfill_commit", manifest_key, "torn",
+                _repair_action(repair, "removed"),
+                "unparseable upload manifest (re-executes)",
+            )
+    # -- orphan objects beyond the manifest -----------------------------
+    listed = set((manifest or {}).get("objects", {}))
+    for key in store.list(base):
+        rel = key[len(base) + 1:]
+        if rel == SHARD_MANIFEST_NAME or rel in listed:
+            continue
+        if repair:
+            store.delete(key)
+        _issue(
+            issues, "store_object", key, "orphan",
+            _repair_action(repair, "removed"),
+            "object not named by the shard's upload manifest",
+        )
+
+
+def audit_backfill_store(store, prefix, repair: bool = True,
+                         clock=time.time) -> dict:
+    """Fsck one OBJECT-STORE backfill job prefix
+    (:mod:`tpudas.backfill.objqueue`): verify the plan, sweep
+    torn/stale leases, finish crashed commits (verifying upload
+    manifest without its done marker → adopted; torn/bodiless markers
+    → removed so the shard re-executes), classify orphan objects (not
+    named by any upload manifest) and torn partial uploads
+    (``store.list_uploads`` → aborted), and audit the stitched
+    result's manifest the same way.  Everything is classified from
+    ``list()`` + content-token verification — the store-plane
+    equivalent of the directory walks in :func:`audit_backfill`.
+
+    Committed shard BYTES are verified against their manifests'
+    content tokens (that is what ``manifest_verifies`` does); the
+    deep per-folder :func:`audit` runs on materialized local copies
+    at stitch time instead.
+
+    Run only while no worker is active on the prefix — same caveat
+    as the POSIX fsck."""
+    from tpudas.backfill.objqueue import (
+        RESULT_DONE_KEY,
+        RESULT_MANIFEST_KEY,
+        RESULT_PREFIX,
+        SHARDS_PREFIX,
+        StoreBackfillQueue,
+    )
+
+    prefix = str(prefix).strip("/")
+    root = f"store:{prefix}"
+    t0 = time.perf_counter()
+    issues: list = []
+    parked: list = []
+    error = None
+    queue = None
+    with span("backfill.audit", root=root):
+        try:
+            queue = StoreBackfillQueue(
+                store, prefix, worker="fsck", clock=clock
+            )
+        except Exception as exc:
+            error = (
+                f"unreadable backfill plan: {type(exc).__name__}: "
+                f"{str(exc)[:200]}"
+            )
+            log_event(
+                "backfill_audit_plan_unreadable", root=root, error=error,
+            )
+            _issue(
+                issues, "backfill_plan",
+                f"{prefix}/backfill.json" if prefix else "backfill.json",
+                "corrupt", "failed", error,
+            )
+        if queue is not None:
+            shard_ids = [sh["id"] for sh in queue.plan["shards"]]
+            for sid in shard_ids:
+                _store_shard_check(queue, sid, issues, repair, clock)
+                if queue.is_parked(sid):
+                    parked.append(sid)
+            # shard prefixes the plan does not know — debris from a
+            # re-plan under a reused prefix, or key corruption
+            known = set(shard_ids)
+            shards_base = queue._key(SHARDS_PREFIX)
+            for key in store.list(shards_base):
+                sid = key[len(shards_base) + 1:].split("/", 1)[0]
+                if sid in known:
+                    continue
+                if repair:
+                    store.delete(key)
+                _issue(
+                    issues, "store_object", key, "orphan",
+                    _repair_action(repair, "removed"),
+                    f"object under unknown shard {sid!r}",
+                )
+            # torn partial uploads anywhere under the job prefix
+            for key in store.list_uploads(prefix):
+                if repair:
+                    store.abort_upload(key)
+                _issue(
+                    issues, "store_upload", key, "torn",
+                    _repair_action(repair, "aborted"),
+                    "partial upload (crashed writer)",
+                )
+            # -- the stitched result -----------------------------------
+            result_done_key = queue._key(RESULT_DONE_KEY)
+            result_manifest_key = queue._key(RESULT_MANIFEST_KEY)
+            result_base = queue._key(RESULT_PREFIX)
+            done_payload, done_token = queue._get_verified(
+                result_done_key
+            )
+            result_done = done_payload is not None
+            if done_token is not None and not result_done:
+                if repair:
+                    store.delete(result_done_key)
+                _issue(
+                    issues, "backfill_result", result_done_key, "torn",
+                    _repair_action(repair, "removed"),
+                    "unreadable result marker",
+                )
+            rman, rman_token = queue._get_verified(result_manifest_key)
+            rverified = rman is not None and all(
+                store.head(f"{result_base}/{rel}") == tok
+                for rel, tok in rman.get("objects", {}).items()
+            )
+            if result_done and not rverified:
+                # marker without verifying bytes: the stitch is a
+                # deterministic pure function of committed shards, so
+                # the cheap, always-correct repair is re-stitch
+                if repair:
+                    store.delete(result_done_key)
+                    store.delete(result_manifest_key)
+                _issue(
+                    issues, "backfill_result", result_done_key,
+                    "corrupt", _repair_action(repair, "removed"),
+                    "result marker without a verifying manifest "
+                    "(re-stitch)",
+                )
+                result_done = False
+            if not result_done and rman_token is not None:
+                if repair:
+                    store.delete(result_manifest_key)
+                    rman = None
+                _issue(
+                    issues, "backfill_result", result_manifest_key,
+                    "torn", _repair_action(repair, "removed"),
+                    "half-committed result (re-stitch)",
+                )
+            listed = set((rman or {}).get("objects", {}))
+            for key in store.list(result_base):
+                rel = key[len(result_base) + 1:]
+                if rel in listed:
+                    continue
+                if repair:
+                    store.delete(key)
+                _issue(
+                    issues, "store_object", key, "orphan",
+                    _repair_action(repair, "removed"),
+                    "result object not named by the result manifest",
+                )
+    elapsed = time.perf_counter() - t0
+    get_registry().counter(
+        "tpudas_integrity_audit_runs_total",
+        "integrity audits (startup fsck) executed",
+    ).inc()
+    repaired = sum(
+        1 for it in issues if it["action"] in _REPAIRED_ACTIONS
+    )
+    clean = error is None and all(
+        it["action"] in _REPAIRED_ACTIONS for it in issues
+    )
+    report = {
+        "root": root,
+        "repair": bool(repair),
+        "clean": bool(clean),
+        "elapsed_s": round(elapsed, 4),
+        "repaired": repaired,
+        "parked": parked,
+        "issues": issues,
+        "counts": queue.counts() if queue is not None else {},
+        "issues_total": len(issues),
     }
     if error is not None:
         report["error"] = error

@@ -13,6 +13,13 @@ Taxonomy (:func:`classify_failure`):
   offending file in the quarantine ledger; after
   ``RetryPolicy.quarantine_after`` strikes the file is excluded from
   the spool index and the round proceeds without it.
+- ``"network"`` — the remote storage tier answered badly or not at
+  all: any :class:`NetworkFaultError` (connection reset, 5xx, timeout,
+  dropped response from an object-store backend).  Retried like a
+  transient, but kept distinct in metrics/ledgers because the remedy
+  differs — a network storm wants capped-exponential patience and the
+  cold-tier degradation ladder (:mod:`tpudas.store.cache`), not
+  quarantine: the bytes are fine, the wire is not.
 - ``"resource"`` — the disk (or quota) is full: ``OSError`` with
   ``ENOSPC``/``EDQUOT``.  Retried like a transient but with extra
   patience (``max_consecutive * resource_patience`` attempts — a full
@@ -81,6 +88,16 @@ block).  Production code marks its fault sites with
   (the trace must never take down the stream), and a
   ``KeyboardInterrupt`` kill models a crash mid-flush — the readers
   and the audit recover the segment's verified prefix.
+- ``"store.op"`` — the head of every object-store backend call
+  (tpudas/store/base.py), BEFORE the backend touches anything: a
+  raise here is a clean 5xx/unavailable — the operation never
+  applied, a blind retry is always safe.
+- ``"store.op.sent"`` — after a store mutation (put/CAS/delete)
+  APPLIED but before its token returns: a raise here is a **dropped
+  response** — the write landed, the caller never heard.  The
+  lost-CAS drill lives at this site; recovery is the token re-read in
+  :mod:`tpudas.store.retry`.  Context carries ``path`` (the object
+  key) and ``op`` so ``match=`` can target one artifact class.
 """
 
 from __future__ import annotations
@@ -98,6 +115,7 @@ __all__ = [
     "FaultBoundary",
     "FaultPlan",
     "FaultSpec",
+    "NetworkFaultError",
     "RetryPolicy",
     "SpoolReadError",
     "TransientFaultError",
@@ -110,6 +128,13 @@ __all__ = [
 class TransientFaultError(OSError):
     """An injected (or explicitly tagged) transient fault — an
     ``OSError`` so the taxonomy needs no special case for it."""
+
+
+class NetworkFaultError(OSError):
+    """A remote storage/network failure — the taxonomy's ``"network"``
+    kind.  Defined here (not in tpudas.store) so
+    :func:`classify_failure` needs no import of the store package;
+    ``tpudas.store.base.StoreNetworkError`` subclasses this."""
 
 
 class SpoolReadError(Exception):
@@ -131,17 +156,19 @@ RESOURCE_ERRNOS = (errno.ENOSPC, errno.EDQUOT)
 
 
 def classify_failure(exc: BaseException) -> str:
-    """``"transient"`` | ``"corrupt"`` | ``"resource"`` | ``"fatal"``
-    for one exception.
+    """``"transient"`` | ``"corrupt"`` | ``"network"`` | ``"resource"``
+    | ``"fatal"`` for one exception.
 
     A :class:`SpoolReadError` wrapping an ``OSError`` is transient (the
     interrogator may still be flushing the file); wrapping anything
     else it is corrupt (the bytes decoded wrong — rereading the same
-    bytes cannot fix that, only quarantine can).  An ``OSError`` with
-    ``ENOSPC``/``EDQUOT`` is resource (the OUTPUT side is full —
-    retrying with shed writers beats dying); any other bare ``OSError``
-    in the round is transient.  Everything else — config, programming,
-    the reference's gap raise — is fatal.
+    bytes cannot fix that, only quarantine can).  A
+    :class:`NetworkFaultError` is network (the remote storage tier
+    misbehaved — retried with backoff, never quarantined).  An
+    ``OSError`` with ``ENOSPC``/``EDQUOT`` is resource (the OUTPUT
+    side is full — retrying with shed writers beats dying); any other
+    bare ``OSError`` in the round is transient.  Everything else —
+    config, programming, the reference's gap raise — is fatal.
     """
     if isinstance(exc, SpoolReadError):
         return (
@@ -149,6 +176,8 @@ def classify_failure(exc: BaseException) -> str:
         )
     if isinstance(exc, MemoryError):
         return "fatal"
+    if isinstance(exc, NetworkFaultError):
+        return "network"
     if isinstance(exc, OSError):
         if getattr(exc, "errno", None) in RESOURCE_ERRNOS:
             return "resource"
@@ -408,6 +437,8 @@ FAULT_SITES = (
     "backfill.claim",
     "backfill.commit",
     "obs.flight_write",
+    "store.op",
+    "store.op.sent",
 )
 
 _ACTIONS = ("raise", "truncate", "delay")

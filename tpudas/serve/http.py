@@ -127,14 +127,22 @@ _MIN_DEFLATE_BYTES = 256
 class _Mount:
     """One mounted output folder: its query engine plus the per-mount
     ledger/score-store caches.  The root mount serves the bare
-    endpoints; stream mounts serve ``/s/<stream_id>/...``."""
+    endpoints; stream mounts serve ``/s/<stream_id>/...``.
+
+    ``remote`` (a :class:`tpudas.store.tileplane.RemotePyramid`) makes
+    this a STATELESS SERVING REPLICA: ``folder`` is the remote's local
+    mirror directory, tile objects materialize lazily per query
+    through the NVMe read-through cache, and the mount's whole durable
+    state can be wiped and re-hydrated from the object store."""
 
     def __init__(self, folder, stream_id=None, cache_tiles=256,
-                 engine=None):
+                 engine=None, remote=None):
         self.folder = str(folder)
         self.stream_id = stream_id
+        self.remote = remote
         self.engine = QueryEngine(
-            self.folder, cache_tiles=cache_tiles, engine=engine
+            self.folder, cache_tiles=cache_tiles, engine=engine,
+            tile_prefetch=None if remote is None else remote.prefetch,
         )
         self._events_cache = None
         self._score_store_cache = None
@@ -436,6 +444,15 @@ class _Handler(BaseHTTPRequestHandler):
             self._account(reg, endpoint, 503, t_start)
             return
         try:
+            if (
+                mount is not None
+                and mount.remote is not None
+                and gated
+            ):
+                # rate-limited manifest probe (min_refresh_s); a cold
+                # tier outage flips the remote stale and the mirror
+                # keeps serving (RESILIENCE.md "Cold tier down")
+                mount.remote.refresh()
             with span(
                 "serve.request", endpoint=endpoint,
                 stream=stream_id or "",
@@ -524,9 +541,40 @@ class _Handler(BaseHTTPRequestHandler):
         return 404
 
     # -- control plane -------------------------------------------------
+    @staticmethod
+    def _store_block(mount):
+        """The ``store`` health block for a remote-pyramid mount:
+        refresh state, generation, and the read-through cache's
+        hit/stale/degraded snapshot — plus whether the mount is
+        currently degraded (cold tier unreachable, serving
+        stale-but-verified bytes)."""
+        if mount is None or mount.remote is None:
+            return None, False
+        snap = mount.remote.snapshot()
+        degraded = bool(
+            snap.get("stale")
+            or (snap.get("cache") or {}).get("degraded")
+        )
+        snap["status"] = "degraded" if degraded else "ok"
+        return snap, degraded
+
     def _healthz(self, mount) -> int:
         payload = read_health(mount.folder)
+        store_block, store_degraded = self._store_block(mount)
         if payload is None:
+            if store_block is not None:
+                # a stateless serving replica has no realtime health
+                # snapshot; its liveness IS the store plane's
+                self._send_json(
+                    200,
+                    {"status": (
+                        "degraded" if store_degraded else "ok"
+                    ),
+                     "detail": "serving replica (no local realtime "
+                               "health snapshot)",
+                     "store": store_block},
+                )
+                return 200
             self._send_json(
                 503,
                 {"status": "unknown",
@@ -535,7 +583,12 @@ class _Handler(BaseHTTPRequestHandler):
             )
             return 503
         body = dict(payload)
-        body["status"] = "degraded" if payload.get("degraded") else "ok"
+        body["status"] = (
+            "degraded" if payload.get("degraded") or store_degraded
+            else "ok"
+        )
+        if store_block is not None:
+            body["store"] = store_block
         self._send_json(200, body)
         return 200
 
@@ -891,6 +944,11 @@ class _Handler(BaseHTTPRequestHandler):
                  "tile_len": int(store.tile_len)},
             )
             return 404
+        if mount.remote is not None and valid == store.tile_len:
+            # materialize the addressed completed-tile object into the
+            # mirror (read-through cached; no-op when already local —
+            # the partial head tile serves from mirrored tails)
+            mount.remote._fetch_tile(store, level, idx)
         headers = [
             ("X-Tpudas-Level", level),
             ("X-Tpudas-Tile", idx),
@@ -1086,19 +1144,66 @@ class DASServer:
     may be omitted; :meth:`for_fleet` builds the ``streams`` mapping
     from a fleet root's directory layout.  All mounts share one
     admission gate and the one process registry.
+
+    ``store_url`` (+ ``store_prefix``) mounts an OBJECT-STORE pyramid
+    instead of (or on top of) a local folder: the server becomes a
+    stateless serving replica that hydrates a local mirror through an
+    NVMe read-through cache (``cache_dir``/``cache_bytes``), probes
+    the remote manifest at most every ``store_refresh_s`` seconds
+    before data queries, and keeps serving the mirror (flagged
+    ``degraded`` in ``/healthz``'s ``store`` block) when the cold
+    tier is unreachable.  See SERVING.md "Object-store serving".
     """
 
     def __init__(self, folder=None, host="127.0.0.1", port=0,
                  max_inflight=_DEFAULT_MAX_INFLIGHT, cache_tiles=256,
-                 engine=None, streams=None, reuse_port=False):
-        if folder is None and not streams:
+                 engine=None, streams=None, reuse_port=False,
+                 store_url=None, store_prefix="", cache_dir=None,
+                 cache_bytes=None, store_refresh_s=1.0):
+        if folder is None and not streams and store_url is None:
             raise ValueError(
-                "DASServer needs a folder, streams, or both"
+                "DASServer needs a folder, streams, or a store_url"
             )
+        self.remote = None
+        if store_url is not None:
+            # stateless serving replica (ISSUE 18): hydrate a local
+            # mirror + NVMe read-through cache from the object store;
+            # `folder` (when given) IS the mirror directory, otherwise
+            # a private temp dir — either can be wiped freely
+            import tempfile
+
+            from tpudas.store import (
+                ReadThroughCache,
+                RemotePyramid,
+                store_from_url,
+            )
+
+            base = (
+                str(cache_dir) if cache_dir is not None
+                else tempfile.mkdtemp(prefix="tpudas-serve-store-")
+            )
+            cache_kwargs = (
+                {} if cache_bytes is None
+                else {"max_bytes": int(cache_bytes)}
+            )
+            cache = ReadThroughCache(
+                os.path.join(base, "cache"), **cache_kwargs
+            )
+            mirror = (
+                str(folder) if folder is not None
+                else os.path.join(base, "mirror")
+            )
+            self.remote = RemotePyramid(
+                store_from_url(store_url), store_prefix, cache,
+                mirror, min_refresh_s=float(store_refresh_s),
+            )
+            self.remote.refresh(force=True)
+            folder = mirror
         self.folder = None if folder is None else str(folder)
         mount = (
             None if folder is None
-            else _Mount(folder, cache_tiles=cache_tiles, engine=engine)
+            else _Mount(folder, cache_tiles=cache_tiles, engine=engine,
+                        remote=self.remote)
         )
         mounts = {}
         for sid, sfolder in (streams or {}).items():
@@ -1217,11 +1322,30 @@ def main(argv=None) -> int:
     ap.add_argument("--fleet", action="store_true",
                     help="serve a fleet root: mount every "
                          "<root>/<stream_id>/ at /s/<stream_id>/...")
+    ap.add_argument("--store-url", default=None,
+                    help="serve a remote pyramid from this object "
+                         "store (file:///path, s3://bucket/..., "
+                         "fake:tag); FOLDER becomes the local mirror")
+    ap.add_argument("--store-prefix", default="",
+                    help="stream prefix inside the store")
+    ap.add_argument("--cache-dir", default=None,
+                    help="NVMe read-through cache directory "
+                         "(default: private temp dir)")
+    ap.add_argument("--cache-bytes", type=int, default=None,
+                    help="read-through cache budget in bytes")
     args = ap.parse_args(argv)
+    kwargs = {}
+    if args.store_url:
+        if args.fleet:
+            ap.error("--store-url and --fleet are mutually exclusive")
+        kwargs.update(
+            store_url=args.store_url, store_prefix=args.store_prefix,
+            cache_dir=args.cache_dir, cache_bytes=args.cache_bytes,
+        )
     serve_forever(
         args.folder, host=args.host, port=args.port,
         max_inflight=args.max_inflight, cache_tiles=args.cache_tiles,
-        fleet=args.fleet,
+        fleet=args.fleet, **kwargs,
     )
     return 0
 

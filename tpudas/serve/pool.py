@@ -111,11 +111,37 @@ def _worker_main(cfg: dict, report_q) -> None:
         max_inflight=cfg["max_inflight"],
         cache_tiles=cfg["cache_tiles"],
     )
+    if cfg.get("store_url"):
+        # stateless replica workers: each worker hydrates its OWN
+        # mirror + read-through cache from the object store — workers
+        # share nothing but the store (and whatever CDN sits in front)
+        base = cfg.get("cache_dir")
+        kwargs.update(
+            store_url=cfg["store_url"],
+            store_prefix=cfg.get("store_prefix", ""),
+            cache_dir=(
+                os.path.join(base, f"worker{cfg['index']}")
+                if base else None
+            ),
+            cache_bytes=cfg.get("cache_bytes"),
+        )
     if cfg["fleet"]:
         data = DASServer.for_fleet(
             cfg["folder"], port=cfg["port"], reuse_port=True, **kwargs
         )
         control = DASServer.for_fleet(cfg["folder"], port=0, **kwargs)
+    elif cfg.get("store_url"):
+        data = DASServer(
+            cfg["folder"], port=cfg["port"], reuse_port=True, **kwargs
+        )
+        # the control plane serves /metrics from THIS process's
+        # registry; mount the data server's mirror rather than build
+        # a second remote (one store plane per worker)
+        control = DASServer(
+            data.folder, port=0, host=cfg["host"],
+            max_inflight=cfg["max_inflight"],
+            cache_tiles=cfg["cache_tiles"],
+        )
     else:
         data = DASServer(
             cfg["folder"], port=cfg["port"], reuse_port=True, **kwargs
@@ -194,17 +220,21 @@ class ServePool:
     ``.start()``/``.stop()``.  ``port=0`` picks a free shared data
     port; ``control_port=0`` an ephemeral control port (tests)."""
 
-    def __init__(self, folder, host="127.0.0.1", port=8000,
+    def __init__(self, folder=None, host="127.0.0.1", port=8000,
                  workers=_DEFAULT_WORKERS, control_port=0, fleet=False,
                  max_inflight=8, cache_tiles=256,
                  start_timeout=120.0, max_restarts=5,
-                 restart_backoff=0.5, supervise=True):
+                 restart_backoff=0.5, supervise=True,
+                 store_url=None, store_prefix="", cache_dir=None,
+                 cache_bytes=None):
         if not has_reuse_port():
             raise OSError(
                 "SO_REUSEPORT is not available on this platform; "
                 "the serve pool needs it to share one data port"
             )
-        self.folder = str(folder)
+        if folder is None and store_url is None:
+            raise ValueError("ServePool needs a folder or a store_url")
+        self.folder = None if folder is None else str(folder)
         self.host = str(host)
         self.workers = int(workers)
         if self.workers < 1:
@@ -214,6 +244,9 @@ class ServePool:
             folder=self.folder, host=self.host, fleet=self.fleet,
             max_inflight=int(max_inflight),
             cache_tiles=int(cache_tiles),
+            store_url=store_url, store_prefix=str(store_prefix),
+            cache_dir=None if cache_dir is None else str(cache_dir),
+            cache_bytes=cache_bytes,
         )
         self.port = int(port) or self._pick_port()
         self._control_addr = (self.host, int(control_port))
@@ -508,7 +541,19 @@ def main(argv=None) -> int:
     ap.add_argument("--fleet", action="store_true",
                     help="serve a fleet root: every worker mounts "
                          "every <root>/<stream_id>/")
+    ap.add_argument("--store-url", default=None,
+                    help="serve a remote pyramid from this object "
+                         "store; each worker hydrates its own "
+                         "mirror + cache (stateless replicas)")
+    ap.add_argument("--store-prefix", default="",
+                    help="stream prefix inside the store")
+    ap.add_argument("--cache-dir", default=None,
+                    help="base cache directory (per-worker subdirs)")
+    ap.add_argument("--cache-bytes", type=int, default=None,
+                    help="per-worker read-through cache budget")
     args = ap.parse_args(argv)
+    if args.store_url and args.fleet:
+        ap.error("--store-url and --fleet are mutually exclusive")
     control_port = (
         args.port + 1 if args.control_port is None else
         args.control_port
@@ -517,7 +562,9 @@ def main(argv=None) -> int:
         args.folder, host=args.host, port=args.port,
         workers=args.workers, control_port=control_port,
         fleet=args.fleet, max_inflight=args.max_inflight,
-        cache_tiles=args.cache_tiles,
+        cache_tiles=args.cache_tiles, store_url=args.store_url,
+        store_prefix=args.store_prefix, cache_dir=args.cache_dir,
+        cache_bytes=args.cache_bytes,
     )
     with pool:
         print(

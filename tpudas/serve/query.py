@@ -87,9 +87,14 @@ class QueryEngine:
     """Cached, coalesced window reads over one output folder."""
 
     def __init__(self, folder, cache_tiles: int = _DEFAULT_CACHE_TILES,
-                 engine=None):
+                 engine=None, tile_prefetch=None):
         self.folder = str(folder)
         self.engine = engine
+        # optional hook ``(store, level, lo, hi)`` called before a
+        # pyramid read: a RemotePyramid materializes the window's tile
+        # objects into the local mirror so TileStore finds them
+        # (tpudas.store.tileplane; None on a plain local folder)
+        self.tile_prefetch = tile_prefetch
         self._store = TileStore.open(self.folder, engine=engine)
         self._index = DirectoryIndex(self.folder)
         self._cache: OrderedDict = OrderedDict()
@@ -459,6 +464,8 @@ class QueryEngine:
         # the pyramid-covered span
         i_tiles_hi = min(i_hi, max(n_k, i_mid))
         if i_mid < i_tiles_hi:
+            if self.tile_prefetch is not None:
+                self.tile_prefetch(store, level, i_mid, i_tiles_hi)
             parts.append(
                 store.read(
                     level, i_mid, i_tiles_hi, agg=agg,

@@ -1,0 +1,94 @@
+"""Object-store tile plane: pluggable storage backends.
+
+Public surface:
+
+- :mod:`tpudas.store.base` — the contract (put / put_if CAS / get /
+  head / delete / list), errors, content-derived tokens;
+- :mod:`tpudas.store.posix` / :mod:`tpudas.store.s3` /
+  :mod:`tpudas.store.fake` — the three backends;
+- :mod:`tpudas.store.retry` — idempotency-aware network-error retry;
+- :mod:`tpudas.store.cache` — the NVMe read-through tier;
+- :mod:`tpudas.store.tileplane` — the pyramid publisher and the
+  remote (multi-host) pyramid reader;
+- :func:`store_from_url` — one string configures the whole plane.
+"""
+
+from __future__ import annotations
+
+from tpudas.store.base import (
+    CASConflictError,
+    ObjectNotFoundError,
+    ObjectStore,
+    StoreError,
+    StoreNetworkError,
+    token_of,
+)
+from tpudas.store.cache import ReadThroughCache
+from tpudas.store.fake import FakeObjectStore, FaultInjector, FaultRule
+from tpudas.store.posix import PosixStore
+from tpudas.store.retry import STORE_RETRY_POLICY, RetryingStore
+from tpudas.store.tileplane import PyramidPublisher, RemotePyramid
+
+__all__ = [
+    "CASConflictError",
+    "FakeObjectStore",
+    "FaultInjector",
+    "FaultRule",
+    "ObjectNotFoundError",
+    "ObjectStore",
+    "PosixStore",
+    "PyramidPublisher",
+    "ReadThroughCache",
+    "RemotePyramid",
+    "RetryingStore",
+    "STORE_RETRY_POLICY",
+    "StoreError",
+    "StoreNetworkError",
+    "store_from_url",
+    "token_of",
+]
+
+# one process-wide fake per URL tag, so every component a test wires
+# with "fake:xyz" talks to the SAME in-memory store (mirrors how every
+# component pointed at one bucket shares state)
+_FAKES: dict = {}
+
+
+def store_from_url(url: str, retry: bool = True,
+                   policy=None, sleep_fn=None) -> ObjectStore:
+    """Build a (by default retry-wrapped) backend from a URL:
+
+    - ``file:///abs/path`` or a bare path → :class:`PosixStore`;
+    - ``s3://bucket/prefix`` → :class:`S3Store` (needs boto3 or an
+      injected client — construct directly for the latter);
+    - ``fake:`` / ``fake:tag`` → a process-shared
+      :class:`FakeObjectStore` per tag (tests, drills).
+
+    ``retry=False`` returns the raw backend (drills that must see
+    every injected fault exactly once)."""
+    url = str(url)
+    if url.startswith("fake:"):
+        tag = url[len("fake:"):]
+        store = _FAKES.get(tag)
+        if store is None:
+            store = _FAKES[tag] = FakeObjectStore()
+    elif url.startswith("s3://"):
+        from tpudas.store.s3 import S3Store
+
+        rest = url[len("s3://"):]
+        bucket, _, prefix = rest.partition("/")
+        if not bucket:
+            raise StoreError(f"s3 url missing bucket: {url!r}")
+        store = S3Store(bucket, prefix)
+    else:
+        if url.startswith("file://"):
+            url = url[len("file://"):]
+        store = PosixStore(url)
+    if not retry:
+        return store
+    kwargs = {}
+    if policy is not None:
+        kwargs["policy"] = policy
+    if sleep_fn is not None:
+        kwargs["sleep_fn"] = sleep_fn
+    return RetryingStore(store, **kwargs)
