@@ -1,0 +1,579 @@
+"""tpudas.live: the push subscription plane (ISSUE 19).
+
+Covers the acceptance set: bounded per-client queues (never exceed
+depth), deterministic degrade→drop ladder, snapshot-then-delta
+byte-consistency against a pull ``/query`` of the same window,
+``Last-Event-ID`` sequence-gap resume (ring replay vs snapshot
+fallback), crash-only parity (a fault — or a KI-kill, slow leg — at
+``live.emit`` leaves the round loop's durable products byte-identical
+to a no-subscriber control), fleet ``/s/<id>/live`` routing with
+unknown-id 404, and the ``LFProc.add_emit_listener`` hardening
+satellite (a raising listener is counted and skipped, never poisoning
+the commit path).
+"""
+
+import base64
+import glob
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpudas import spool
+from tpudas.codec import decode_tile
+from tpudas.live import find_hub, register_hub, reset_hubs
+from tpudas.live.hub import DEGRADE_FACTOR, LiveFrame, LiveHub
+from tpudas.live.protocol import delta_event, resume_frames
+from tpudas.obs.registry import MetricsRegistry, use_registry
+from tpudas.proc.lfproc import LFProc
+from tpudas.serve.http import DASServer
+from tpudas.testing import (
+    FaultPlan,
+    FaultSpec,
+    install_fault_plan,
+    make_synthetic_spool,
+)
+
+# same stream fixture vocabulary as tests/test_serve.py
+from test_serve import FS, FILE_SEC, NCH, T0, _append_files, _run_stream
+
+
+@pytest.fixture(autouse=True)
+def _fresh_hubs():
+    reset_hubs()
+    yield
+    reset_hubs()
+
+
+def _frame(seq, rnd=None, rows=16, nch=4, seed=0):
+    rng = np.random.default_rng(seed + seq)
+    t0 = np.int64(1_700_000_000_000_000_000) + seq * rows * 10**9
+    times = t0 + np.arange(rows, dtype=np.int64) * 10**9
+    data = rng.standard_normal((rows, nch)).astype(np.float32)
+    return LiveFrame(seq, rnd if rnd is not None else seq, times, data,
+                     [], 10**9)
+
+
+def _publish_n(hub, n, start=1, **kw):
+    for i in range(start, start + n):
+        fr = _frame(i, **kw)
+        with hub._lock:
+            hub.seq = fr.seq
+            hub._ring.append(fr)
+        hub._fanout(fr)
+
+
+def _sse_events(raw: str):
+    """[(event, id_or_None, data_dict_or_None)] from an SSE stream,
+    complete blocks only."""
+    out = []
+    complete = raw.rsplit("\n\n", 1)[0]
+    for block in complete.split("\n\n"):
+        ev = ident = data = None
+        for line in block.splitlines():
+            if line.startswith("event: "):
+                ev = line[7:]
+            elif line.startswith("id: "):
+                ident = int(line[4:])
+            elif line.startswith("data: "):
+                data = json.loads(line[6:])
+        if ev is not None:
+            out.append((ev, ident, data))
+    return out
+
+
+def _read_sse(url, want_events=1, timeout=15.0, headers=()):
+    req = urllib.request.Request(url)
+    for k, v in headers:
+        req.add_header(k, v)
+    resp = urllib.request.urlopen(req, timeout=timeout)
+    buf = b""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        chunk = resp.read(512)
+        if not chunk:
+            break
+        buf += chunk
+        if len(_sse_events(buf.decode())) >= want_events:
+            break
+    resp.close()
+    return _sse_events(buf.decode())
+
+
+def _h5_digests(folder):
+    return {
+        os.path.basename(f): hashlib.sha256(
+            open(f, "rb").read()
+        ).hexdigest()
+        for f in sorted(glob.glob(os.path.join(folder, "*.h5")))
+    }
+
+
+class TestBoundedQueue:
+    def test_queue_never_exceeds_depth(self):
+        hub = LiveHub("s", queue_depth=3, max_level=1, ring=8)
+        sub = hub.subscribe()
+        for i in range(1, 20):
+            _publish_n(hub, 1, start=i)
+            assert sub.qsize() <= 3
+        # never drained at max level → the ladder dropped it
+        assert sub.dropped == "slow"
+        assert hub.n_subscribers() == 0
+
+    def test_degrade_then_drop_ladder_is_deterministic(self):
+        """Depth D, max level M: a never-reading client gets exactly
+        D queued, M degrade steps (each shedding one oldest frame),
+        then the drop — nothing about timing or rates involved."""
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            hub = LiveHub("s", queue_depth=2, max_level=2, ring=16)
+            sub = hub.subscribe()
+            outcomes = []
+            for i in range(1, 7):
+                fr = _frame(i)
+                outcomes.append(sub.offer(fr))
+            assert outcomes == [
+                "queued", "queued",          # D = 2
+                "degraded", "degraded",      # M = 2 ladder rungs
+                "dropped",                   # ladder exhausted
+                "dead",                      # already gone
+            ]
+            assert sub.level == 2
+            assert sub.dropped == "slow"
+            assert sub.degrades == 2
+            # each degrade shed exactly one oldest frame; the drop
+            # cleared the rest
+            assert sub.qsize() == 0
+
+    def test_subscriber_cap_sheds_with_reason(self):
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            hub = LiveHub("s", max_subscribers=1)
+            assert hub.subscribe() is not None
+            assert hub.subscribe() is None
+            assert reg.value(
+                "tpudas_live_subscribers_dropped_total",
+                reason="capacity",
+            ) == 1
+
+    def test_degrade_level_rows_match_block_mean(self):
+        fr = _frame(1, rows=10, nch=3)
+        lvl1 = fr.level_array(1)
+        f = DEGRADE_FACTOR
+        expect = np.concatenate([
+            fr.data[:8].reshape(2, f, 3).mean(axis=1),
+            fr.data[8:].mean(axis=0, keepdims=True),
+        ]).astype(np.float32)
+        np.testing.assert_array_equal(lvl1, expect)
+        assert fr.level_times(1).size == lvl1.shape[0]
+        # payload cache: same (level, codec) object is reused
+        assert fr.payload(1) is fr.payload(1)
+        np.testing.assert_array_equal(decode_tile(fr.payload(1)), lvl1)
+
+
+class TestResume:
+    def test_gap_inside_ring_replays(self):
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            hub = LiveHub("s", ring=16)
+            _publish_n(hub, 5)
+            frames = resume_frames(hub, 2)
+            assert [f.seq for f in frames] == [3, 4, 5]
+            assert reg.value(
+                "tpudas_live_resumes_total", result="replay"
+            ) == 1
+
+    def test_gap_beyond_ring_falls_back_to_snapshot(self):
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            hub = LiveHub("s", ring=2)
+            _publish_n(hub, 6)
+            assert resume_frames(hub, 1) is None
+            assert reg.value(
+                "tpudas_live_resumes_total", result="snapshot"
+            ) == 1
+
+    def test_up_to_date_client_replays_nothing(self):
+        hub = LiveHub("s", ring=4)
+        _publish_n(hub, 3)
+        assert resume_frames(hub, 3) == []
+
+
+class TestListenerHardening:
+    """ISSUE 19 satellite: LFProc.add_emit_listener — a raising
+    listener is counted (``tpudas_lfproc_listener_errors_total``) and
+    skipped for the round's remaining emissions instead of poisoning
+    the commit path."""
+
+    def test_raising_listener_is_counted_and_skipped(self, tmp_path):
+        src = str(tmp_path / "raw")
+        out = str(tmp_path / "out")
+        make_synthetic_spool(
+            src, n_files=4, file_duration=FILE_SEC, fs=FS, n_ch=NCH,
+            noise=0.01,
+        )
+        reg = MetricsRegistry()
+        with use_registry(reg):
+            lfp = LFProc(spool(src).sort("time").update())
+            lfp.update_processing_parameter(
+                output_sample_interval=1.0,
+                process_patch_size=40,
+                edge_buff_size=8,
+            )
+            lfp.set_output_folder(out, delete_existing=True)
+            good, bad = [], []
+
+            def raising(patch):
+                bad.append(patch)
+                raise RuntimeError("broken consumer")
+
+            lfp.add_emit_listener(raising)
+            lfp.add_emit_listener(good.append)
+            t0 = np.datetime64(T0)
+            lfp.process_time_range(
+                t0, t0 + np.timedelta64(int(2 * FILE_SEC), "s")
+            )
+            # output committed, good listener saw every emission
+            assert glob.glob(os.path.join(out, "*.h5"))
+            assert len(good) >= 1
+            # the raising listener fired ONCE, then was skipped
+            assert len(bad) == 1
+            assert reg.value(
+                "tpudas_lfproc_listener_errors_total"
+            ) == 1
+            # re-armed for the next round by the driver
+            lfp.clear_emit_failures()
+            assert lfp._failed_listeners == set()
+
+
+class TestEndToEnd:
+    @pytest.fixture()
+    def live_streamed(self, tmp_path):
+        """3 + 2 + 2 files over 3 rounds with live + pyramid on."""
+        src = str(tmp_path / "raw")
+        out = str(tmp_path / "results")
+        make_synthetic_spool(
+            src, n_files=3, file_duration=FILE_SEC, fs=FS, n_ch=NCH,
+            noise=0.01,
+        )
+        rounds = _run_stream(
+            src, out, feed_batches=[(3, 2), (5, 2)], max_rounds=3,
+            live=True, pyramid=True,
+        )
+        assert rounds == 3
+        hub = find_hub(folder=out)
+        assert hub is not None and hub.seq >= 2
+        return src, out, hub
+
+    def test_snapshot_then_delta_matches_pull_query(
+        self, live_streamed
+    ):
+        """The lossless snapshot + replayed deltas reconstruct exactly
+        what GET /query serves for the same windows."""
+        _src, out, hub = live_streamed
+        with DASServer(out, port=0) as srv:
+            events = _read_sse(
+                srv.base_url + "/live?window=30&heartbeat=0.2",
+                want_events=2, timeout=20,
+            )
+            kinds = [e[0] for e in events]
+            assert kinds[0] == "hello"
+            assert events[0][2]["seq"] == hub.seq
+            # snapshot vs /query of the SAME window
+            snap = next(d for ev, _i, d in events if ev == "snapshot")
+            t0_ns = snap["t0_ns"]
+            n = snap["rows"]
+            step = snap["step_ns"]
+            q = urllib.request.Request(
+                srv.base_url + "/query?"
+                + f"t0={t0_ns}&t1={t0_ns + n * step}&format=npy"
+            )
+            buf = urllib.request.urlopen(q, timeout=30).read()
+            import io
+
+            pulled = np.load(io.BytesIO(buf))
+            pushed = decode_tile(base64.b64decode(snap["blob"]))
+            assert pushed.dtype == np.float32
+            np.testing.assert_array_equal(
+                pushed, np.asarray(pulled, np.float32)
+            )
+            # deltas replayed from seq 0 are byte-identical to the
+            # hub's ring frames (lossless default codec)
+            deltas = _read_sse(
+                srv.base_url + "/live?window=0&heartbeat=0.2&last_id=0",
+                want_events=1 + hub.seq, timeout=20,
+            )
+            ring = {f.seq: f for f in list(hub._ring)}
+            n_checked = 0
+            for ev, ident, data in deltas:
+                if ev != "delta":
+                    continue
+                assert ident == data["seq"]
+                got = decode_tile(base64.b64decode(data["blob"]))
+                np.testing.assert_array_equal(
+                    got, ring[data["seq"]].level_array(data["level"])
+                )
+                n_checked += 1
+            assert n_checked >= 2
+
+    def test_sequence_gap_resume_over_http(self, live_streamed):
+        _src, out, hub = live_streamed
+        with DASServer(out, port=0) as srv:
+            # gap inside the ring: Last-Event-ID header wins, missed
+            # deltas replay in order with their ids
+            events = _read_sse(
+                srv.base_url + "/live?window=0&heartbeat=0.2",
+                want_events=hub.seq,  # hello + deltas 2..seq
+                timeout=20,
+                headers=(("Last-Event-ID", "1"),),
+            )
+            ids = [i for ev, i, _d in events if ev == "delta"]
+            assert ids == list(range(2, hub.seq + 1))
+
+    def test_flight_record_and_slo_carry_live_block(
+        self, live_streamed
+    ):
+        from tpudas.obs.collect import live_entry, slo_status
+        from tpudas.obs.flight import read_flight
+
+        _src, out, hub = live_streamed
+        rounds = read_flight(out, kind="round")
+        blocks = [r["live"] for r in rounds if "live" in r]
+        assert blocks, "round records carry no live block"
+        folded = live_entry(rounds)
+        assert folded["published"] == hub.published
+        assert "live" in slo_status(out)
+
+    def test_fault_at_live_emit_keeps_outputs_byte_identical(
+        self, tmp_path
+    ):
+        """The fast crash-only leg: every live publish raising (the
+        ``live.emit`` fault site) changes NOTHING durable — outputs
+        byte-identical to a control run with no live plane at all."""
+
+        def run(leg, live, plan=None):
+            src = str(tmp_path / f"raw_{leg}")
+            out = str(tmp_path / f"out_{leg}")
+            make_synthetic_spool(
+                src, n_files=3, file_duration=FILE_SEC, fs=FS,
+                n_ch=NCH, noise=0.01,
+            )
+            reg = MetricsRegistry()
+            with use_registry(reg), install_fault_plan(
+                plan or FaultPlan()
+            ):
+                rounds = _run_stream(
+                    src, out, feed_batches=[(3, 2)], max_rounds=2,
+                    live=live, pyramid=True,
+                )
+            assert rounds == 2
+            return out, reg
+
+        plan = FaultPlan(
+            FaultSpec("live.emit", action="raise", at=1, times=99,
+                      exc=RuntimeError)
+        )
+        out_control, _ = run("control", live=False)
+        out_faulted, reg = run("faulted", live=True, plan=plan)
+        assert reg.value("tpudas_live_publish_errors_total") >= 2
+        assert _h5_digests(out_faulted) == _h5_digests(out_control)
+
+    def test_subscribers_never_change_outputs(self, tmp_path):
+        """Attached (and never-reading, ladder-dropped) subscribers
+        leave the round loop's durable products byte-identical to the
+        no-subscriber control."""
+
+        def run(leg, live, attach=False):
+            src = str(tmp_path / f"raw_{leg}")
+            out = str(tmp_path / f"out_{leg}")
+            make_synthetic_spool(
+                src, n_files=3, file_duration=FILE_SEC, fs=FS,
+                n_ch=NCH, noise=0.01,
+            )
+            subs = []
+
+            def on_round(rnd, lfp):
+                if attach and not subs:
+                    hub = find_hub(folder=out)
+                    # stalled client: subscribes, never reads
+                    subs.append(
+                        hub.subscribe(depth=1)
+                    )
+
+            reg = MetricsRegistry()
+            with use_registry(reg):
+                _run_stream(
+                    src, out, feed_batches=[(3, 2)], max_rounds=3,
+                    live=live, pyramid=True, on_round=on_round,
+                )
+            return out, subs
+
+        out_control, _ = run("nosub", live=False)
+        out_live, subs = run("stalled", live=True, attach=True)
+        assert _h5_digests(out_live) == _h5_digests(out_control)
+        # and the stalled client went down the ladder, not the loop
+        assert subs and (
+            subs[0].dropped == "slow" or subs[0].degrades > 0
+            or subs[0].qsize() <= 1
+        )
+
+
+class TestFleetRouting:
+    def test_stream_mount_and_unknown_id(self, tmp_path):
+        src = str(tmp_path / "raw")
+        out = str(tmp_path / "results")
+        make_synthetic_spool(
+            src, n_files=3, file_duration=FILE_SEC, fs=FS, n_ch=NCH,
+            noise=0.01,
+        )
+        _run_stream(src, out, max_rounds=1, live=True)
+        hub = find_hub(folder=out)
+        assert hub is not None
+        register_hub("sA")  # also reachable by the fleet stream id
+        with DASServer(streams={"sA": out}, port=0) as srv:
+            events = _read_sse(
+                srv.base_url + "/s/sA/live?window=0&heartbeat=0.2"
+                + "&last_id=0",
+                want_events=2, timeout=20,
+            )
+            assert events[0][0] == "hello"
+            # unknown stream id: 404 with the stream list
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    srv.base_url + "/s/nope/live", timeout=10
+                )
+            assert ei.value.code == 404
+            # bare /live on a fleet-only server: route hint 404
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    srv.base_url + "/live", timeout=10
+                )
+            assert ei.value.code == 404
+
+    def test_no_producer_is_503(self, tmp_path):
+        out = str(tmp_path / "results")
+        os.makedirs(out)
+        reset_hubs()
+        with DASServer(out, port=0) as srv:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    srv.base_url + "/live", timeout=10
+                )
+            assert ei.value.code == 503
+            assert ei.value.headers.get("Retry-After")
+
+
+class TestBridge:
+    def test_bridge_mirrors_frames_across_registries(self):
+        from tpudas.live.sse import BridgeSubscriber, LiveBridge
+
+        hub = register_hub("bstream")
+        bridge = LiveBridge().start()
+        try:
+            addr = bridge.address
+            reset_hubs()  # simulate the worker process's empty registry
+            sub = BridgeSubscriber(addr, retry_s=0.1).start()
+            try:
+                deadline = time.time() + 10
+                # frames broadcast only to connections that exist at
+                # publish time — wait for the worker to attach first
+                while time.time() < deadline and not bridge._conns:
+                    time.sleep(0.02)
+                assert bridge._conns, "worker never connected"
+                _publish_n(hub, 3)
+                mirror = None
+                while time.time() < deadline:
+                    mirror = find_hub(stream_id="bstream")
+                    if mirror is not None and mirror.seq >= 3:
+                        break
+                    time.sleep(0.05)
+                assert mirror is not None and mirror.seq == 3
+                a = mirror.latest_frame()
+                b = hub.latest_frame()
+                assert a.seq == b.seq
+                np.testing.assert_array_equal(
+                    a.level_array(0), b.level_array(0)
+                )
+                # the mirrored frame reuses the producer's encoding
+                assert a.payload(0) == b.payload(0)
+            finally:
+                sub.stop()
+        finally:
+            bridge.stop()
+
+
+_KILL_CHILD = r"""
+import os, signal, sys
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, {tests!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+from tpudas.resilience.faults import FaultPlan, FaultSpec
+from tpudas.resilience import faults as _faults
+from test_serve import _run_stream
+
+def _kill9(_seconds):
+    os.kill(os.getpid(), signal.SIGKILL)
+
+plan = FaultPlan(
+    FaultSpec("live.emit", action="delay", at=1, seconds=0.0,
+              sleep_fn=_kill9)
+)
+_faults._PLAN = plan
+_run_stream({src!r}, {out!r}, max_rounds=2, live=True, pyramid=True)
+raise SystemExit("unreachable: the kill never fired")
+"""
+
+
+class TestKillAtLiveEmit:
+    @pytest.mark.slow
+    def test_sigkill_at_live_emit_then_resume_matches_control(
+        self, tmp_path
+    ):
+        """The real KI-kill leg: SIGKILL the producer process exactly
+        at the first ``live.emit`` (after the round's commit, before
+        its health write), resume the stream to completion, and the
+        durable products are byte-identical to an untouched control
+        run — the push plane held nothing the disk did not."""
+        repo = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        src = str(tmp_path / "raw")
+        out = str(tmp_path / "out_killed")
+        make_synthetic_spool(
+            src, n_files=5, file_duration=FILE_SEC, fs=FS, n_ch=NCH,
+            noise=0.01,
+        )
+        script = _KILL_CHILD.format(
+            repo=repo, tests=os.path.join(repo, "tests"),
+            src=src, out=out,
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True, text=True, timeout=600,
+        )
+        assert proc.returncode == -9, (
+            f"child was not SIGKILLed: rc={proc.returncode} "
+            f"stderr={proc.stderr[-2000:]}"
+        )
+        # resume: the restarted stream re-derives its position from
+        # disk and finishes the work
+        rounds = _run_stream(src, out, max_rounds=2, live=True,
+                             pyramid=True)
+        assert rounds >= 1
+        # control: same source bytes, straight through, live off
+        src_c = str(tmp_path / "raw_control")
+        out_c = str(tmp_path / "out_control")
+        import shutil
+
+        shutil.copytree(src, src_c)
+        _run_stream(src_c, out_c, max_rounds=3, live=False,
+                    pyramid=True)
+        assert _h5_digests(out) == _h5_digests(out_c)

@@ -62,6 +62,7 @@ _HEADLINE_PATTERNS = (
     (re.compile(r"overhead", re.I), "down"),
     (re.compile(r"lag", re.I), "down"),
     (re.compile(r"spread", re.I), "down"),
+    (re.compile(r"(^|_)p(50|90|95|99)(_|$)", re.I), "down"),
     (re.compile(r"(wall|_seconds|_s)$", re.I), "down"),
 )
 # structural keys never treated as headlines even when numeric

@@ -187,6 +187,20 @@ REQUIRED_METRICS = (
     "tpudas_store_degraded",
     "tpudas_store_published_tiles_total",
     "tpudas_store_generation_invalidations_total",
+    # live push plane (PR 19): tools/live_bench.py reads the fan-out
+    # counters by name, /slo surfaces fanout_p99_s, SERVING.md "Live
+    # subscriptions" keys its runbook off the drop reasons
+    "tpudas_live_subscribers",
+    "tpudas_live_frames_published_total",
+    "tpudas_live_frames_sent_total",
+    "tpudas_live_frames_dropped_total",
+    "tpudas_live_subscribers_dropped_total",
+    "tpudas_live_degrades_total",
+    "tpudas_live_fanout_seconds",
+    "tpudas_live_snapshots_total",
+    "tpudas_live_resumes_total",
+    "tpudas_live_publish_errors_total",
+    "tpudas_lfproc_listener_errors_total",
 )
 REQUIRED_SPANS = (
     "serve.request",
@@ -226,6 +240,9 @@ REQUIRED_SPANS = (
     "store.delete",
     "store.list",
     "store.publish",
+    # live push plane (PR 19)
+    "live.publish",
+    "live.fanout",
 )
 
 

@@ -96,6 +96,17 @@ synchronous slice loop — the byte-identity comparison then proves
 both that a prefetched slice is crash-equivalent to a never-read one
 AND that the async path's durable bytes equal the sync path's.
 
+``--live`` (ISSUE 19) drills the LIVE PUSH PLANE: every drilled cycle
+runs with ``TPUDAS_LIVE=1`` and a roster of in-process subscribers
+attached from round 2 on (``TPUDAS_CRASH_DRILL_SUBS``, never drained —
+so SIGKILLs land while the degrade ladder is mid-shed) while the
+control replay runs live-off.  The live plane holds ZERO durable
+state, so the existing byte-identity comparisons (outputs, pyramid,
+detect state) are exactly the crash-only claim for it: publishing to
+a thousand slow clients and dying mid-fanout must leave the same
+bytes as never having had a subscriber.  Not supported with
+``--streams``.
+
 ``tests/test_integrity.py`` runs a small seeded smoke in tier-1 and
 the full drill under ``-m slow``; ``tests/test_fleet.py`` smokes the
 fleet drill.
@@ -147,6 +158,24 @@ def _worker(src: str, out: str, engine: str) -> int:
 
     from tpudas.proc.streaming import run_lowpass_realtime
 
+    # --live leg: attach a never-drained subscriber roster once the
+    # hub exists, so SIGKILLs land while the degrade ladder is
+    # mid-shed (the live plane is memory-only; nothing durable may
+    # change because of it)
+    n_subs = int(os.environ.get("TPUDAS_CRASH_DRILL_SUBS", "0"))
+    attached = {"subs": None}
+
+    def _attach(_rnd, _lfp):
+        if attached["subs"] is not None:
+            return
+        from tpudas.live.hub import find_hub
+
+        hub = find_hub(folder=out)
+        if hub is not None:
+            attached["subs"] = [
+                hub.subscribe() for _ in range(n_subs)
+            ]
+
     # ready marker BESIDE the output folder: the parent starts its
     # kill timer only after the interpreter/jax warm-up is done, so
     # kills land in processing, not in `import jax`
@@ -168,6 +197,7 @@ def _worker(src: str, out: str, engine: str) -> int:
         detect=True,
         detect_operators=DETECT_OPS,
         max_rounds=8,
+        on_round=_attach if n_subs else None,
     )
     return 0
 
@@ -450,6 +480,8 @@ def run_drill(
     log_path: str | None = None,
     mesh: int = 0,
     async_ingest: bool = False,
+    live: bool = False,
+    live_subs: int = 32,
 ) -> dict:
     """One full drill for ``engine``; returns the report dict with
     ``ok`` True when the audit is clean and both comparisons match.
@@ -465,7 +497,14 @@ def run_drill(
     the CONTROL replay runs the synchronous slice loop — SIGKILLs
     land with prefetched-but-uncommitted slices in flight, and the
     byte-identity comparison then proves a prefetched slice is
-    crash-equivalent to a never-read one."""
+    crash-equivalent to a never-read one.
+
+    ``live`` (ISSUE 19) runs every DRILLED cycle with the live push
+    plane on and ``live_subs`` never-drained in-process subscribers
+    (``TPUDAS_LIVE=1`` + ``TPUDAS_CRASH_DRILL_SUBS``) while the
+    CONTROL replay runs live-off — the comparison proves fanning out
+    to stalled clients and dying mid-publish changes no durable
+    byte."""
     import numpy as np
 
     from tpudas.integrity.audit import audit
@@ -475,17 +514,24 @@ def run_drill(
     )
     if async_ingest:
         tag = tag[:-1] + "_async_"
+    if live:
+        tag = tag[:-1] + "_live_"
     workdir = workdir or tempfile.mkdtemp(prefix=tag)
     src = os.path.join(workdir, "src")
     out = os.path.join(workdir, "out")
     ctrl = os.path.join(workdir, "ctrl")
     log_fh = open(log_path, "ab") if log_path else None
-    drill_env = (
-        {"TPUDAS_INGEST_PREFETCH": "2"} if async_ingest else None
-    )
-    ctrl_env = (
-        {"TPUDAS_INGEST_PREFETCH": "0"} if async_ingest else None
-    )
+    drill_env: dict = {}
+    ctrl_env: dict = {}
+    if async_ingest:
+        drill_env["TPUDAS_INGEST_PREFETCH"] = "2"
+        ctrl_env["TPUDAS_INGEST_PREFETCH"] = "0"
+    if live:
+        drill_env["TPUDAS_LIVE"] = "1"
+        drill_env["TPUDAS_CRASH_DRILL_SUBS"] = str(int(live_subs))
+        ctrl_env["TPUDAS_LIVE"] = "0"
+    drill_env = drill_env or None
+    ctrl_env = ctrl_env or None
     try:
         # epochs: every feed event, replayed verbatim for the control
         epochs = [(0, files_init)]
@@ -551,6 +597,8 @@ def run_drill(
             "engine": engine,
             "mesh": int(mesh),
             "async_ingest": bool(async_ingest),
+            "live": bool(live),
+            "live_subs": int(live_subs) if live else 0,
             "cycles": int(cycles),
             "seed": int(seed),
             "kills": kills,
@@ -752,7 +800,23 @@ def main(argv=None) -> int:
         "slices in flight, proving prefetched == never-read "
         "(ISSUE 15); not supported with --streams",
     )
+    ap.add_argument(
+        "--live", action="store_true",
+        help="run the DRILLED cycles with the live push plane on "
+        "(TPUDAS_LIVE=1) and --live-subs never-drained subscribers "
+        "attached, while the control replay runs live-off — SIGKILLs "
+        "land mid-fanout with the degrade ladder shedding, proving "
+        "the memory-only push plane changes no durable byte "
+        "(ISSUE 19); not supported with --streams",
+    )
+    ap.add_argument(
+        "--live-subs", type=int, default=32,
+        help="in-process subscribers per drilled cycle for --live",
+    )
     args = ap.parse_args(argv)
+    if args.streams and args.live:
+        ap.error("--live drills the single-stream worker; combine "
+                 "with --mesh or plain engines")
     if args.streams and args.async_ingest:
         ap.error("--async-ingest drills the single-stream worker; "
                  "combine with --mesh or plain engines")
@@ -797,11 +861,12 @@ def main(argv=None) -> int:
             continue
         print(f"crash_drill: engine={engine} cycles={args.cycles} "
               f"seed={args.seed} mesh={args.mesh} "
-              f"async_ingest={args.async_ingest}")
+              f"async_ingest={args.async_ingest} live={args.live}")
         rep = run_drill(
             engine=engine, cycles=args.cycles, seed=args.seed,
             log_path=args.log, mesh=args.mesh,
             async_ingest=args.async_ingest, workdir=wd,
+            live=args.live, live_subs=args.live_subs,
         )
         results[engine] = rep
         ok = ok and rep["ok"]
@@ -818,8 +883,8 @@ def main(argv=None) -> int:
     payload = {"cycles": args.cycles, "seed": args.seed,
                "mesh": args.mesh, "streams": args.streams,
                "batched": args.batched, "codec": args.codec,
-               "async_ingest": args.async_ingest, "ok": ok,
-               "engines": results}
+               "async_ingest": args.async_ingest, "live": args.live,
+               "ok": ok, "engines": results}
     if args.out:
         with open(args.out, "w") as fh:
             json.dump(payload, fh, indent=1)

@@ -1,0 +1,397 @@
+"""Live-plane bench: fan-out at scale + slow-client shedding + round overhead.
+
+Measures what ISSUE 19 promises for ``tpudas/live``:
+
+1. **Fan-out** — one :class:`LiveHub` pushing round frames to >= 1000
+   concurrent in-process subscribers (a drainer pool keeps them read),
+   reporting the per-delivery publish->drain latency P50/P99 (the same
+   ``note_fanout`` samples the SSE loop feeds) and the per-publish
+   wall P99 across the whole roster.
+2. **Stall injection** — the same roster never reads a byte.  The
+   degrade ladder must fire deterministically (depth D queued, then
+   ``max_level`` degrades each shedding the oldest frame, then a
+   counted ``slow`` drop) and the publish wall must stay flat: slow
+   clients degrade and drop, the producer never stalls (PR 4
+   shed-don't-queue, applied to the push plane).
+3. **Round overhead** — a real ``run_lowpass_realtime`` run with
+   ``live=True`` and >= 1000 drained subscribers attached from round
+   2 on.  The fraction of the round body
+   (``tpudas_stream_round_body_seconds``) spent in the ``live`` phase
+   (``tpudas_stream_round_phase_seconds{phase="live"}``) must be
+   **< 2%**; a live-off control run of the same stream is reported
+   alongside as the A/B wall check.
+
+Acceptance (the ``ok`` flag): >= 1000 subscribers in every leg, a
+measured fan-out P99, stall leg sheds (degrades == max_level * subs,
+drops == subs) with publish P99 bounded, and live round overhead
+< 2%.
+
+CLI:
+
+    JAX_PLATFORMS=cpu python tools/live_bench.py [--out BENCH_pr19.json]
+        [--subs 1200] [--frames 24] [--rounds 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+T0 = "2023-03-22T00:00:00"
+# frame shape for the synthetic legs: one steady round's decimated
+# output at interrogator scale (dt_out=1 s over a ~1 min round,
+# 256 channels) — the fan-out cost is per-subscriber bookkeeping, not
+# per-byte, but the payload should still be production-shaped
+FRAME_ROWS = 60
+FRAME_CH = 256
+STEP_NS = 1_000_000_000
+
+# driver leg: a steady single-file round per poll (detect_bench's
+# feeding pattern), small enough for CI but real enough that the live
+# phase is measured against a genuine round body
+FS = 500.0
+FILE_SEC = 60.0
+N_CH = 64
+DT_OUT = 1.0
+EDGE_SEC = 5.0
+PATCH_OUT = 30
+
+
+def _make_frame(seq: int):
+    import numpy as np
+
+    from tpudas.live.hub import LiveFrame
+
+    rng = np.random.default_rng(seq)
+    t0 = np.datetime64(T0).astype("datetime64[ns]").astype(np.int64)
+    times = (
+        t0 + seq * FRAME_ROWS * STEP_NS
+        + np.arange(FRAME_ROWS, dtype=np.int64) * STEP_NS
+    )
+    data = (0.1 * rng.standard_normal(
+        (FRAME_ROWS, FRAME_CH))).astype(np.float32)
+    return LiveFrame(seq, seq, times, data, [], STEP_NS)
+
+
+class _DrainerPool:
+    """A few threads sweeping many subscriptions: each drained frame
+    feeds ``hub.note_fanout`` with its publish->drain latency, exactly
+    what the SSE write loop reports per client."""
+
+    def __init__(self, hub, subs, n_threads=4):
+        self.hub = hub
+        self.subs = list(subs)
+        self.delivered = 0
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        chunk = max(1, (len(subs) + n_threads - 1) // n_threads)
+        self._threads = [
+            threading.Thread(
+                target=self._run, args=(self.subs[i:i + chunk],),
+                daemon=True,
+            )
+            for i in range(0, len(subs), chunk)
+        ]
+
+    def _run(self, subs):
+        while not self._stop.is_set():
+            moved = 0
+            for sub in subs:
+                while True:
+                    frame = sub.next(timeout=0)
+                    if frame is None:
+                        break
+                    self.hub.note_fanout(
+                        time.perf_counter() - frame.published_perf
+                    )
+                    moved += 1
+            if moved:
+                with self._lock:
+                    self.delivered += moved
+            else:
+                # idle sweep: yield so the publisher gets the core
+                self._stop.wait(0.002)
+
+    def start(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self, settle: float = 0.5):
+        # let the queues empty before tearing down
+        deadline = time.perf_counter() + settle
+        while time.perf_counter() < deadline:
+            if all(s.qsize() == 0 for s in self.subs):
+                break
+            time.sleep(0.01)
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+
+
+def bench_fanout(n_subs: int, n_frames: int) -> dict:
+    """Leg 1: publish wall + delivery latency across a drained roster."""
+    import numpy as np
+
+    from tpudas.live.hub import LiveHub
+
+    hub = LiveHub(
+        "bench_fanout", queue_depth=32, max_level=2,
+        max_subscribers=n_subs + 16,
+    )
+    subs = [hub.subscribe() for _ in range(n_subs)]
+    assert all(s is not None for s in subs)
+    pool = _DrainerPool(hub, subs).start()
+    publish_wall = []
+    try:
+        for seq in range(1, n_frames + 1):
+            frame = _make_frame(seq)
+            t0 = time.perf_counter()
+            hub.inject(frame)
+            publish_wall.append(time.perf_counter() - t0)
+            time.sleep(0.01)  # realistic inter-round gap (scaled down)
+    finally:
+        pool.stop()
+    p99 = hub.fanout_p99()
+    window = np.asarray(publish_wall)
+    return {
+        "subscribers": n_subs,
+        "frames": n_frames,
+        "frame_shape": [FRAME_ROWS, FRAME_CH],
+        "delivered": pool.delivered,
+        "published": hub.published,
+        "degrades": hub.degrades,
+        "frames_dropped": hub.frames_dropped,
+        "subscribers_dropped": hub.subs_dropped,
+        "fanout_p50_s": round(
+            float(np.percentile(
+                np.asarray(list(hub._fanout_s)), 50)), 6)
+        if hub._fanout_s else None,
+        "fanout_p99_s": None if p99 is None else round(p99, 6),
+        "publish_wall_p99_s": round(float(np.percentile(window, 99)), 6),
+        "publish_wall_mean_s": round(float(window.mean()), 6),
+        "ok": bool(
+            hub.published == n_frames
+            and p99 is not None
+            and pool.delivered > 0
+        ),
+    }
+
+
+def bench_stall(n_subs: int, n_frames: int) -> dict:
+    """Leg 2: nobody reads.  The ladder must shed deterministically
+    and the publish wall must stay flat — the producer never blocks on
+    a slow client."""
+    import numpy as np
+
+    from tpudas.live.hub import LiveHub
+
+    depth, max_level = 8, 2
+    hub = LiveHub(
+        "bench_stall", queue_depth=depth, max_level=max_level,
+        max_subscribers=n_subs + 16,
+    )
+    subs = [hub.subscribe() for _ in range(n_subs)]
+    publish_wall = []
+    for seq in range(1, n_frames + 1):
+        frame = _make_frame(seq)
+        t0 = time.perf_counter()
+        hub.inject(frame)
+        publish_wall.append(time.perf_counter() - t0)
+    window = np.asarray(publish_wall)
+    # ladder determinism at roster scale: every stalled client takes
+    # exactly max_level degrade steps then one counted slow drop
+    want_degrades = max_level * n_subs
+    all_slow = all(s.dropped == "slow" for s in subs)
+    p99 = float(np.percentile(window, 99))
+    return {
+        "subscribers": n_subs,
+        "frames": n_frames,
+        "queue_depth": depth,
+        "max_level": max_level,
+        "degrades": hub.degrades,
+        "frames_dropped": hub.frames_dropped,
+        "subscribers_dropped": hub.subs_dropped,
+        "publish_wall_p99_s": round(p99, 6),
+        "publish_wall_mean_s": round(float(window.mean()), 6),
+        "ok": bool(
+            hub.degrades == want_degrades
+            and hub.subs_dropped == n_subs
+            and all_slow
+            and hub.n_subscribers() == 0
+            and p99 < 0.25
+        ),
+    }
+
+
+def _feed_file(src, index):
+    import numpy as np
+
+    from tpudas.testing import make_synthetic_spool
+
+    make_synthetic_spool(
+        src, n_files=1, file_duration=FILE_SEC, fs=FS, n_ch=N_CH,
+        noise=0.01,
+        start=np.datetime64(T0)
+        + np.timedelta64(int(index * FILE_SEC * 1e9), "ns"),
+        prefix=f"raw{index:04d}",
+    )
+
+
+def _drive(src, out, rounds, live, on_round=None):
+    from tpudas.proc.streaming import run_lowpass_realtime
+
+    fed = {"n": 2}
+
+    def sleep(_s):
+        if fed["n"] < rounds + 1:
+            _feed_file(src, fed["n"])
+            fed["n"] += 1
+
+    return run_lowpass_realtime(
+        source=src, output_folder=out, start_time=T0,
+        output_sample_interval=DT_OUT, edge_buffer=EDGE_SEC,
+        process_patch_size=PATCH_OUT, poll_interval=0.0,
+        sleep_fn=sleep, live=live, on_round=on_round,
+    )
+
+
+def _hist(reg, metric, **labels):
+    m = reg.get(metric)
+    if m is None:
+        return {"count": 0, "sum": 0.0}
+    snap = m.snapshot(**labels)
+    return {"count": snap["count"], "sum": snap["sum"]}
+
+
+def bench_overhead(n_subs: int, rounds: int, workdir=None) -> dict:
+    """Leg 3: live round overhead against a real driver run."""
+    from tpudas.live.hub import find_hub, reset_hubs
+    from tpudas.obs.registry import MetricsRegistry, use_registry
+
+    workdir = workdir or tempfile.mkdtemp(prefix="live_bench_")
+    # warm-up run: compiles the filter cascade out of the measurement
+    warm_src = os.path.join(workdir, "warm_src")
+    _feed_file(warm_src, 0)
+    _feed_file(warm_src, 1)
+    _drive(warm_src, os.path.join(workdir, "warm_out"), 2, False)
+
+    # control: identical stream, live off
+    src_a = os.path.join(workdir, "src_a")
+    _feed_file(src_a, 0)
+    _feed_file(src_a, 1)
+    reg_a = MetricsRegistry()
+    with use_registry(reg_a):
+        _drive(src_a, os.path.join(workdir, "out_a"), rounds, False)
+    body_a = _hist(reg_a, "tpudas_stream_round_body_seconds")
+
+    # measured: live on, the roster attached from round 2 on
+    reset_hubs()
+    src_b = os.path.join(workdir, "src_b")
+    out_b = os.path.join(workdir, "out_b")
+    _feed_file(src_b, 0)
+    _feed_file(src_b, 1)
+    state = {"pool": None, "subs": []}
+
+    def attach(_rnd, _lfp):
+        if state["pool"] is not None:
+            return
+        hub = find_hub(folder=out_b)
+        if hub is None:
+            return
+        state["subs"] = [hub.subscribe() for _ in range(n_subs)]
+        state["pool"] = _DrainerPool(
+            hub, [s for s in state["subs"] if s is not None]
+        ).start()
+
+    reg_b = MetricsRegistry()
+    try:
+        with use_registry(reg_b):
+            _drive(src_b, out_b, rounds, True, on_round=attach)
+    finally:
+        if state["pool"] is not None:
+            state["pool"].stop()
+    body_b = _hist(reg_b, "tpudas_stream_round_body_seconds")
+    live_b = _hist(
+        reg_b, "tpudas_stream_round_phase_seconds", phase="live"
+    )
+    hub = find_hub(folder=out_b)
+    overhead_pct = (
+        100.0 * live_b["sum"] / body_b["sum"] if body_b["sum"] else 0.0
+    )
+    return {
+        "subscribers": n_subs,
+        "rounds": rounds,
+        "fs_hz": FS, "channels": N_CH, "file_sec": FILE_SEC,
+        "round_body_s_mean_live_off": round(
+            body_a["sum"] / max(body_a["count"], 1), 5),
+        "round_body_s_mean_live_on": round(
+            body_b["sum"] / max(body_b["count"], 1), 5),
+        "live_phase_s_total": round(live_b["sum"], 5),
+        "live_overhead_pct": round(overhead_pct, 3),
+        "frames_published": 0 if hub is None else hub.published,
+        "degrades": 0 if hub is None else hub.degrades,
+        "subscribers_dropped": 0 if hub is None else hub.subs_dropped,
+        "fanout_p99_s": (
+            None if hub is None or hub.fanout_p99() is None
+            else round(hub.fanout_p99(), 6)
+        ),
+        "acceptance_overhead_lt_pct": 2.0,
+        "ok": bool(
+            overhead_pct < 2.0
+            and (hub is not None and hub.published >= rounds - 1)
+        ),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default=None, help="write JSON report here")
+    ap.add_argument("--subs", type=int, default=1200,
+                    help="concurrent subscribers per leg (>= 1000)")
+    ap.add_argument("--frames", type=int, default=24)
+    ap.add_argument("--rounds", type=int, default=5)
+    args = ap.parse_args(argv)
+
+    fanout = bench_fanout(args.subs, args.frames)
+    stall = bench_stall(args.subs, args.frames)
+    overhead = bench_overhead(args.subs, args.rounds)
+    ok = bool(
+        fanout["ok"] and stall["ok"] and overhead["ok"]
+        and args.subs >= 1000
+    )
+    payload = {
+        "bench": "live push plane (PR 19)",
+        "config": {"subs": args.subs, "frames": args.frames,
+                   "rounds": args.rounds},
+        "fanout": fanout,
+        "stall": stall,
+        "overhead": overhead,
+        "ok": ok,
+    }
+    text = json.dumps(payload, indent=1, default=str)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(text + "\n")
+    print(text)
+    print(
+        f"live_bench: {args.subs} subscribers, fan-out "
+        f"p99={fanout['fanout_p99_s']}s, stall degrades="
+        f"{stall['degrades']}/drops={stall['subscribers_dropped']}, "
+        f"live overhead={overhead['live_overhead_pct']}% "
+        f"({'OK' if ok else 'FAILED'}, bar 2%)"
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

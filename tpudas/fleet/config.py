@@ -58,6 +58,7 @@ COMMON_FIELDS = (
     "detect_operators",
     "poll_jitter",
     "flight",
+    "live",
 )
 
 # knobs only the low-pass (stateful/joint) driver understands
@@ -134,6 +135,7 @@ class StreamConfig:
     detect_operators: object = None
     poll_jitter: object = None  # fraction; None -> TPUDAS_POLL_JITTER/0
     flight: object = None  # on-disk flight recorder; None -> TPUDAS_FLIGHT/1
+    live: object = None  # live push hub (tpudas.live); None -> TPUDAS_LIVE/0
     # -- lowpass only ---------------------------------------------------
     start_time: object = None
     output_sample_interval: object = None

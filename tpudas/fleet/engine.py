@@ -315,6 +315,51 @@ def _append_pyramid(output_folder, rnd, emitted, state) -> None:
         log_event("pyramid_append", round=rnd, rows=int(appended))
 
 
+def _live_new_events(det_state) -> list:
+    """This round's NEW ledger events (the detect summary counts them;
+    the pipeline's in-memory ledger tail holds them) — what the live
+    frame pushes alongside the decimated rows."""
+    pipe = None if det_state is None else det_state.get("pipe")
+    summary = {} if det_state is None else (
+        det_state.get("summary") or {}
+    )
+    n = int(summary.get("new_events") or 0)
+    if pipe is None or n <= 0:
+        return []
+    return [dict(ev) for ev in pipe.events[-n:]]
+
+
+def _publish_live(hub, rnd, emitted, det_state) -> None:
+    """Per-round live-plane hook: publish this round's emit capture +
+    new detect events to the stream's hub.  Mirrors the pyramid
+    hook's swallow discipline exactly — the push plane holds no
+    durable state, so ANY failure here is counted and dropped on the
+    floor and the round commits as if no subscriber existed (the
+    crash-only property the KI-kill test pins).  ``live.emit`` is the
+    deterministic fault site; a resource error flips the ``live``
+    shed flag so subsequent rounds skip the publish instead of
+    re-failing it."""
+    reg = get_registry()
+    try:
+        fault_point("live.emit", round=rnd)
+        hub.publish(rnd, emitted, _live_new_events(det_state))
+    except Exception as exc:
+        reg.counter(
+            "tpudas_live_publish_errors_total",
+            "live publish/sink callbacks that raised (swallowed; "
+            "the round loop is never poisoned)",
+        ).inc()
+        log_event(
+            "live_publish_failed",
+            round=rnd,
+            error=f"{type(exc).__name__}: {str(exc)[:200]}",
+        )
+        from tpudas.integrity import resource as _resource
+
+        if _resource.is_resource_error(exc):
+            _resource.note_pressure("live", exc)
+
+
 def _place_span_seconds(reg) -> float:
     """Cumulative ``parallel.place`` span seconds from the span
     histogram — the delta around one processing call is that round's
@@ -442,6 +487,11 @@ class StreamRunner:
         # in-flight round's phase timeline
         self.flight = None
         self._round_phases = None
+        # live push plane (ISSUE 19): subclasses resolve the knob via
+        # _init_live; default off so a runner that never calls it
+        # still reads consistently
+        self.live = False
+        self.live_hub = None
         # ragged-batched fleet execution (ISSUE 16): the fleet's group
         # service installs its BatchStepExecutor here for the duration
         # of one batched step; _process_round hands it to the per-round
@@ -462,6 +512,31 @@ class StreamRunner:
             from tpudas.obs.flight import FlightRecorder
 
             self.flight = FlightRecorder(self.output_folder)
+
+    def _init_live(self, cfg):
+        """Attach the live push hub (``live=`` / ``TPUDAS_LIVE``,
+        default off): register this stream's :class:`LiveHub` under
+        its id and absolute output folder (how the serve plane finds
+        it), and — when ``TPUDAS_LIVE_BRIDGE`` names an address —
+        start the process-wide :class:`LiveBridge` so ServePool
+        workers can subscribe.  Sets ``self.live`` and returns the
+        hub (or None)."""
+        live = cfg.live
+        if live is None:
+            live = os.environ.get("TPUDAS_LIVE", "0") == "1"
+        self.live = bool(live)
+        if not self.live:
+            return None
+        from tpudas.live.hub import register_hub
+
+        hub = register_hub(
+            self.stream_id, os.path.abspath(self.output_folder)
+        )
+        if os.environ.get("TPUDAS_LIVE_BRIDGE"):
+            from tpudas.live.sse import ensure_bridge
+
+            ensure_bridge(os.environ["TPUDAS_LIVE_BRIDGE"])
+        return hub
 
     def _flight_record(self, kind: str, **fields) -> None:
         if self.flight is not None:
@@ -577,6 +652,7 @@ class LowpassStreamRunner(StreamRunner):
             detect = os.environ.get("TPUDAS_DETECT", "0") == "1"
         self.detect = bool(detect)
         self.detect_operators = cfg.detect_operators
+        self.live_hub = self._init_live(cfg)
 
         stateful = cfg.stateful
         if stateful is None:
@@ -751,10 +827,11 @@ class LowpassStreamRunner(StreamRunner):
         )
         lfp.set_output_folder(self.output_folder, delete_existing=False)
         emitted_patches = []
-        if self.pyramid or self.detect:
+        if self.pyramid or self.detect or self.live:
             # capture the round's output blocks at their write site for
-            # the in-memory pyramid append and the detect operators
-            # (multi-subscriber emit hook — one capture serves both)
+            # the in-memory pyramid append, the detect operators, and
+            # the live push frame (multi-subscriber emit hook — one
+            # capture serves all three)
             lfp.add_emit_listener(emitted_patches.append)
         if self.rolling_output_folder is not None:
             lfp.set_rolling_output_folder(
@@ -971,6 +1048,13 @@ class LowpassStreamRunner(StreamRunner):
                         step_sec=self.d_t,
                     )
             self.edge_health.detect = self.det_state.get("summary")
+        if self.live and self.live_hub is not None:
+            with ph.measure("live"):
+                if not _resource.should_shed("live"):
+                    _publish_live(
+                        self.live_hub, rnd, emitted_patches,
+                        self.det_state,
+                    )
         self.boundary.on_success()
         with ph.measure("health"):
             self.edge_health.write(
@@ -987,6 +1071,9 @@ class LowpassStreamRunner(StreamRunner):
         # round (its spans, then this record) in the flight ring
         phases_rec = ph.finish(reg)
         self._round_phases = None  # finished: never re-accumulated
+        extra = {}
+        if self.live and self.live_hub is not None:
+            extra["live"] = self.live_hub.round_record()
         self._flight_record(
             "round",
             round=rnd,
@@ -1004,6 +1091,7 @@ class LowpassStreamRunner(StreamRunner):
                 "bound": dev.get("bound"),
                 "utilization": dev.get("utilization"),
             },
+            **extra,
         )
         self._flight_flush()
         if self.on_round is not None:
@@ -1197,6 +1285,7 @@ class RollingStreamRunner(StreamRunner):
             detect = os.environ.get("TPUDAS_DETECT", "0") == "1"
         self.detect = bool(detect)
         self.detect_operators = cfg.detect_operators
+        self.live_hub = self._init_live(cfg)
         self.step_sec = _units.get_seconds(cfg.step)
         self.pyr_state = {"store": None}  # cross-round open tile store
         self.det_state = {"pipe": None}  # cross-round detect pipeline
@@ -1294,7 +1383,7 @@ class RollingStreamRunner(StreamRunner):
             )
             write_s[0] += _time.perf_counter() - t_w0
             self.processed.add(keys[j])
-            if self.pyramid or self.detect:
+            if self.pyramid or self.detect or self.live:
                 emitted_patches.append(out)
 
         # bounded chunks: memory stays O(chunk), outputs are written
@@ -1373,9 +1462,19 @@ class RollingStreamRunner(StreamRunner):
                         self.det_state, operators=self.detect_operators,
                         step_sec=self.step_sec,
                     )
+        if self.live and self.live_hub is not None:
+            with ph.measure("live"):
+                if not _resource.should_shed("live"):
+                    _publish_live(
+                        self.live_hub, rnd, emitted_patches,
+                        self.det_state,
+                    )
         self.rounds = rnd
         phases_rec = ph.finish()
         self._round_phases = None  # finished: never re-accumulated
+        extra = {}
+        if self.live and self.live_hub is not None:
+            extra["live"] = self.live_hub.round_record()
         self._flight_record(
             "round", round=rnd, mode="rolling",
             patches=len(fresh), phases=phases_rec,
@@ -1385,6 +1484,7 @@ class RollingStreamRunner(StreamRunner):
                 "bound": dev.get("bound"),
                 "utilization": dev.get("utilization"),
             },
+            **extra,
         )
         self._flight_flush()
 

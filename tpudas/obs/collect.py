@@ -57,6 +57,7 @@ __all__ = [
     "devprof_entry",
     "fleet_rollup",
     "health_entry",
+    "live_entry",
     "overall_status",
     "pool_rollup",
     "slo_status",
@@ -121,7 +122,7 @@ def slo_status(folder, policy: SLOPolicy | None = None,
         status = "at_risk"
     else:
         status = "ok"
-    return {
+    out = {
         "status": status,
         "head_lag_seconds": head_lag,
         "target_s": target,
@@ -130,6 +131,17 @@ def slo_status(folder, policy: SLOPolicy | None = None,
         "violation_fraction": round(violation_frac, 4),
         "error_budget_burn": round(burn, 3),
     }
+    # live push plane (ISSUE 19): surface the fan-out tail beside the
+    # freshness SLO — a stream can be fresh on disk yet late to its
+    # push subscribers, and /slo is where an operator looks first
+    live = live_entry(rounds)
+    if live is not None:
+        out["live"] = {
+            "subscribers": live["subscribers"],
+            "fanout_p99_s": live["fanout_p99_s"],
+            "dropped_subscribers": live["dropped_subscribers"],
+        }
+    return out
 
 
 def health_entry(health) -> dict:
@@ -211,6 +223,45 @@ def devprof_entry(rounds) -> dict | None:
     }
 
 
+def live_entry(rounds) -> dict | None:
+    """Fold the flight ring's per-round ``live`` records (ISSUE 19:
+    the :class:`tpudas.live.LiveHub` round deltas the runner stamps
+    into every ``round`` record while the push plane is on) into the
+    rollup's fan-out column: current subscriber count, per-window
+    published/dropped/degraded totals, and the newest rolling fan-out
+    P99.  ``None`` when no round carries a live block (push plane
+    off) — read-only over the crash-surviving ring, so it works
+    post-mortem and cross-process like everything here."""
+    recs = [
+        r for r in rounds or []
+        if isinstance(r.get("live"), dict)
+    ]
+    if not recs:
+        return None
+    published = dropped = degrades = subs_dropped = 0
+    for r in recs:
+        lv = r["live"]
+        published += int(lv.get("published") or 0)
+        dropped += int(lv.get("dropped_frames") or 0)
+        degrades += int(lv.get("degrades") or 0)
+        subs_dropped += int(lv.get("dropped_subscribers") or 0)
+    newest = recs[-1]["live"]
+    p99 = None
+    for r in reversed(recs):
+        if r["live"].get("fanout_p99_s") is not None:
+            p99 = r["live"]["fanout_p99_s"]
+            break
+    return {
+        "rounds": len(recs),
+        "subscribers": newest.get("subscribers"),
+        "published": published,
+        "dropped_frames": dropped,
+        "degrades": degrades,
+        "dropped_subscribers": subs_dropped,
+        "fanout_p99_s": p99,
+    }
+
+
 def stream_snapshot(folder, policy: SLOPolicy | None = None) -> dict:
     """One stream folder's rollup entry: verified health + SLO +
     flight freshness + the fleet park/unpark event (timestamps
@@ -234,6 +285,10 @@ def stream_snapshot(folder, policy: SLOPolicy | None = None) -> dict:
     dev = devprof_entry(rounds)
     if dev is not None:
         entry["devprof"] = dev
+    # live push plane (ISSUE 19): same ring scan again
+    live = live_entry(rounds)
+    if live is not None:
+        entry["live"] = live
     return entry
 
 

@@ -29,6 +29,8 @@ phase           what it covers (lowpass runner)
                 carry save
 ``pyramid``     the per-round tile-pyramid append
 ``detect``      the per-round detection hook
+``live``        the per-round live-plane publish + fan-out offer
+                (:mod:`tpudas.live` — bounded, shed-don't-queue)
 ``health``      the health.json / metrics.prom snapshot write
 ==============  =====================================================
 
@@ -69,6 +71,7 @@ PHASES = (
     "commit",
     "pyramid",
     "detect",
+    "live",
     "health",
 )
 
@@ -123,7 +126,7 @@ class RoundPhases:
             "tpudas_stream_round_phase_seconds",
             "per-round wall seconds by round-loop phase (poll / "
             "read_decode / place / device_execute / host_wait / "
-            "commit / pyramid / detect / health)",
+            "commit / pyramid / detect / live / health)",
             labelnames=("phase",),
         )
         out = {}

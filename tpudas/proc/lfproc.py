@@ -292,6 +292,11 @@ class LFProc:
         # another).  Listener failures are counted and swallowed — a
         # read-side consumer must not take down the write path.
         self._emit_listeners: list = []
+        # listeners that raised THIS round: skipped for the remaining
+        # emissions of the round so one broken consumer fails once,
+        # not once per output patch (cleared by the driver's next
+        # round via clear_emit_failures)
+        self._failed_listeners: set = set()
         # cross-check the first Pallas window of each shape against the
         # XLA formulation (off: TPUDAS_PALLAS_VERIFY=0) — a Mosaic
         # miscompile returning silently wrong numbers must not ship
@@ -437,6 +442,12 @@ class LFProc:
         (pyramid append, detect operators); failures are counted and
         swallowed at the emit site."""
         self._emit_listeners.append(fn)
+
+    def clear_emit_failures(self) -> None:
+        """Re-arm listeners skipped after raising (the per-round
+        reset: a consumer that failed on round N's emissions gets a
+        fresh chance on round N+1)."""
+        self._failed_listeners.clear()
 
     def get_last_processed_time(self):
         """Resume primitive: progress state lives entirely in the output
@@ -1437,13 +1448,17 @@ class LFProc:
         t_write = time.perf_counter() - t_w0
         self.timings["write_s"] += t_write
         for listener in self._emit_listeners:
+            if id(listener) in self._failed_listeners:
+                continue  # raised earlier this round: skip, don't re-fail
             try:
                 listener(result)
             except Exception as exc:
+                self._failed_listeners.add(id(listener))
                 get_registry().counter(
-                    "tpudas_emit_listener_errors_total",
+                    "tpudas_lfproc_listener_errors_total",
                     "output-emission listener callbacks that raised "
-                    "(swallowed)",
+                    "(swallowed and skipped for the rest of the "
+                    "round; the commit path is never poisoned)",
                 ).inc()
                 log_event(
                     "emit_listener_failed",

@@ -122,6 +122,7 @@ def run_lowpass_realtime(
     detect_operators=None,
     poll_jitter=None,
     flight=None,
+    live=None,
 ):
     """Poll ``source`` and keep the low-pass output current.
 
@@ -213,6 +214,17 @@ def run_lowpass_realtime(
     (``tools/crash_drill.py`` drills it; see OBSERVABILITY.md
     "Flight recorder format").
 
+    ``live`` (default: off, ``TPUDAS_LIVE=1`` enables) attaches the
+    round loop to the push plane (:mod:`tpudas.live`): each round's
+    emit-captured output rows plus the detect ledger's new events are
+    published as one sequenced frame to the stream's
+    :class:`~tpudas.live.LiveHub`, fanned out to ``GET /live`` SSE
+    subscribers over per-client bounded queues.  The hub holds no
+    durable state and the publish is swallowed-on-failure and shed
+    under disk pressure (``should_shed("live")``), so any number of
+    subscribers leaves the round loop byte-identical to running with
+    none.  See SERVING.md "Live subscriptions".
+
     ``fault_policy`` (a :class:`tpudas.resilience.RetryPolicy`; None =
     defaults) governs the per-round fault boundary: transient/corrupt
     round failures are retried with capped exponential backoff instead
@@ -264,6 +276,7 @@ def run_lowpass_realtime(
         detect_operators=detect_operators,
         poll_jitter=poll_jitter,
         flight=flight,
+        live=live,
     )
     spec = StreamSpec(
         stream_id=_shim_stream_id(output_folder),
@@ -295,6 +308,7 @@ def run_rolling_realtime(
     detect_operators=None,
     poll_jitter=None,
     flight=None,
+    live=None,
 ):
     """Poll ``source`` and rolling-mean each NEW patch (stateless per
     file — rolling_mean_dascore_edge.ipynb:209-221). Returns rounds
@@ -347,6 +361,7 @@ def run_rolling_realtime(
         detect_operators=detect_operators,
         poll_jitter=poll_jitter,
         flight=flight,
+        live=live,
     )
     spec = StreamSpec(
         stream_id=_shim_stream_id(output_folder),

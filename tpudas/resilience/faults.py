@@ -439,6 +439,7 @@ FAULT_SITES = (
     "obs.flight_write",
     "store.op",
     "store.op.sent",
+    "live.emit",
 )
 
 _ACTIONS = ("raise", "truncate", "delay")

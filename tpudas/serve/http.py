@@ -34,6 +34,12 @@ Endpoints (all GET):
   max-age=31536000, immutable``; the partial head tile is
   ``no-cache``.  On a compressed store, ``Accept-Encoding: x-tpt``
   gets the stored :mod:`tpudas.codec` blob verbatim.
+- ``/live``      — Server-Sent-Events push of the decimated stream
+  (ISSUE 19, :mod:`tpudas.live`): ``hello``, a pyramid-backed
+  ``snapshot`` through the same query path as ``/query``, then one
+  codec-compressed ``delta`` per round; ``Last-Event-ID`` resumes.
+  Requires a live producer (``TPUDAS_LIVE=1`` in-process, or a
+  ``--live-bridge`` feed) — otherwise 503 + ``Retry-After``.
 
 Every data-plane response carries a strong content-derived ``ETag``
 and honors ``If-None-Match`` (``304`` with no body on a match), and
@@ -518,7 +524,9 @@ class _Handler(BaseHTTPRequestHandler):
             return self._devprof(params)
         if endpoint == "/profile":
             return self._profile(params)
-        if endpoint in (*_DATA_ENDPOINTS, "/healthz") and mount is None:
+        if endpoint in (*_DATA_ENDPOINTS, "/healthz", "/live") and (
+            mount is None
+        ):
             # fleet-only server, bare endpoint: point at the routes
             self._send_json(
                 404,
@@ -529,6 +537,8 @@ class _Handler(BaseHTTPRequestHandler):
             return 404
         if endpoint == "/healthz":
             return self._healthz(mount)
+        if endpoint == "/live":
+            return self._live(mount, params, stream_id)
         if endpoint == "/query":
             return self._query(mount, params, waterfall=False)
         if endpoint == "/waterfall":
@@ -539,6 +549,32 @@ class _Handler(BaseHTTPRequestHandler):
             return self._tile(mount, params)
         self._send_json(404, {"error": f"unknown endpoint {endpoint!r}"})
         return 404
+
+    # -- live push plane (ISSUE 19) ------------------------------------
+    def _live(self, mount, params: dict, stream_id=None) -> int:
+        """``GET /live`` / ``GET /s/<id>/live`` — the SSE push
+        subscription (snapshot-then-delta, see SERVING.md "Live
+        subscriptions").  Deliberately NOT behind the admission gate:
+        a subscription is open-ended, and thousands of them must not
+        starve the bounded data plane — their cost is bounded by the
+        hub's per-client queues instead."""
+        from tpudas.live.hub import find_hub
+        from tpudas.live.sse import serve_live
+
+        hub = find_hub(
+            stream_id if stream_id is not None else mount.stream_id,
+            mount.folder,
+        )
+        if hub is None:
+            self._send_json(
+                503,
+                {"error": "no live producer attached (run the stream "
+                          "with live=True / TPUDAS_LIVE=1, or point "
+                          "this server at it with live_bridge=)"},
+                headers=(("Retry-After", "5"),),
+            )
+            return 503
+        return serve_live(self, hub, mount, params)
 
     # -- control plane -------------------------------------------------
     @staticmethod
@@ -1159,7 +1195,8 @@ class DASServer:
                  max_inflight=_DEFAULT_MAX_INFLIGHT, cache_tiles=256,
                  engine=None, streams=None, reuse_port=False,
                  store_url=None, store_prefix="", cache_dir=None,
-                 cache_bytes=None, store_refresh_s=1.0):
+                 cache_bytes=None, store_refresh_s=1.0,
+                 live_bridge=None):
         if folder is None and not streams and store_url is None:
             raise ValueError(
                 "DASServer needs a folder, streams, or a store_url"
@@ -1221,6 +1258,13 @@ class DASServer:
             _AdmissionGate(max_inflight), reuse_port=reuse_port,
         )
         self._thread = None
+        # live push plane (ISSUE 19): when the producer runs in a
+        # DIFFERENT process (ServePool worker, remote replica), the
+        # local hub registry is empty — `live_bridge` names the
+        # producer's LiveBridge address and a BridgeSubscriber feeds
+        # mirrored hubs that `/live` then serves from
+        self.live_bridge = live_bridge
+        self._bridge_sub = None
 
     @classmethod
     def for_fleet(cls, root, **kwargs):
@@ -1247,7 +1291,14 @@ class DASServer:
         host, port = self.address
         return f"http://{host}:{port}"
 
+    def _start_bridge(self) -> None:
+        if self.live_bridge and self._bridge_sub is None:
+            from tpudas.live.sse import BridgeSubscriber
+
+            self._bridge_sub = BridgeSubscriber(self.live_bridge).start()
+
     def start(self) -> "DASServer":
+        self._start_bridge()
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="tpudas-serve",
             daemon=True,
@@ -1262,6 +1313,9 @@ class DASServer:
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+        if self._bridge_sub is not None:
+            self._bridge_sub.stop()
+            self._bridge_sub = None
 
     def __enter__(self) -> "DASServer":
         return self.start()
@@ -1295,6 +1349,7 @@ def serve_forever(folder, host="0.0.0.0", port=8000, fleet=False,
             f"tpudas.serve listening on {server.base_url} over {folder}"
         )
     try:
+        server._start_bridge()
         server._httpd.serve_forever()
     except KeyboardInterrupt:
         print("shutting down")
@@ -1333,8 +1388,14 @@ def main(argv=None) -> int:
                          "(default: private temp dir)")
     ap.add_argument("--cache-bytes", type=int, default=None,
                     help="read-through cache budget in bytes")
+    ap.add_argument("--live-bridge", default=None,
+                    help="subscribe to a producer's live bridge at "
+                         "host:port (TPUDAS_LIVE_BRIDGE on the "
+                         "producer) so /live serves its streams")
     args = ap.parse_args(argv)
     kwargs = {}
+    if args.live_bridge:
+        kwargs["live_bridge"] = args.live_bridge
     if args.store_url:
         if args.fleet:
             ap.error("--store-url and --fleet are mutually exclusive")

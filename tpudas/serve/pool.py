@@ -125,14 +125,21 @@ def _worker_main(cfg: dict, report_q) -> None:
             ),
             cache_bytes=cfg.get("cache_bytes"),
         )
+    dkw = dict(kwargs)
+    if cfg.get("live_bridge"):
+        # live push plane (ISSUE 19): ONLY the data server subscribes
+        # to the producer's bridge — a second subscriber on the
+        # control server would double the bridge fan-out for nothing
+        # (hub.inject dedups by sequence, but why pay the bytes)
+        dkw["live_bridge"] = cfg["live_bridge"]
     if cfg["fleet"]:
         data = DASServer.for_fleet(
-            cfg["folder"], port=cfg["port"], reuse_port=True, **kwargs
+            cfg["folder"], port=cfg["port"], reuse_port=True, **dkw
         )
         control = DASServer.for_fleet(cfg["folder"], port=0, **kwargs)
     elif cfg.get("store_url"):
         data = DASServer(
-            cfg["folder"], port=cfg["port"], reuse_port=True, **kwargs
+            cfg["folder"], port=cfg["port"], reuse_port=True, **dkw
         )
         # the control plane serves /metrics from THIS process's
         # registry; mount the data server's mirror rather than build
@@ -144,7 +151,7 @@ def _worker_main(cfg: dict, report_q) -> None:
         )
     else:
         data = DASServer(
-            cfg["folder"], port=cfg["port"], reuse_port=True, **kwargs
+            cfg["folder"], port=cfg["port"], reuse_port=True, **dkw
         )
         control = DASServer(cfg["folder"], port=0, **kwargs)
     control.start()
@@ -226,7 +233,7 @@ class ServePool:
                  start_timeout=120.0, max_restarts=5,
                  restart_backoff=0.5, supervise=True,
                  store_url=None, store_prefix="", cache_dir=None,
-                 cache_bytes=None):
+                 cache_bytes=None, live_bridge=None):
         if not has_reuse_port():
             raise OSError(
                 "SO_REUSEPORT is not available on this platform; "
@@ -247,6 +254,7 @@ class ServePool:
             store_url=store_url, store_prefix=str(store_prefix),
             cache_dir=None if cache_dir is None else str(cache_dir),
             cache_bytes=cache_bytes,
+            live_bridge=None if live_bridge is None else str(live_bridge),
         )
         self.port = int(port) or self._pick_port()
         self._control_addr = (self.host, int(control_port))
@@ -551,6 +559,11 @@ def main(argv=None) -> int:
                     help="base cache directory (per-worker subdirs)")
     ap.add_argument("--cache-bytes", type=int, default=None,
                     help="per-worker read-through cache budget")
+    ap.add_argument("--live-bridge", default=None,
+                    help="producer LiveBridge address (host:port; "
+                         "TPUDAS_LIVE_BRIDGE on the producer) — every "
+                         "data worker subscribes so /live fans out "
+                         "across the pool")
     args = ap.parse_args(argv)
     if args.store_url and args.fleet:
         ap.error("--store-url and --fleet are mutually exclusive")
@@ -564,7 +577,7 @@ def main(argv=None) -> int:
         fleet=args.fleet, max_inflight=args.max_inflight,
         cache_tiles=args.cache_tiles, store_url=args.store_url,
         store_prefix=args.store_prefix, cache_dir=args.cache_dir,
-        cache_bytes=args.cache_bytes,
+        cache_bytes=args.cache_bytes, live_bridge=args.live_bridge,
     )
     with pool:
         print(
