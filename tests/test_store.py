@@ -203,6 +203,72 @@ class TestFakeFaults:
                 store.get("k")
         store.get("k")  # hit 4: clean again
 
+    def test_partition_scoped_to_prefix(self):
+        """A partition severs ONE subtree; the rest keeps answering
+        (how replication drills take down a single mirror's keys)."""
+        store = FakeObjectStore()
+        store.put("a/k", b"x")
+        store.put("b/k", b"y")
+        rule = store.injector.partition(match="a/")
+        for call in (
+            lambda: store.get("a/k"),
+            lambda: store.put("a/k2", b"z"),
+            lambda: store.head("a/k"),
+        ):
+            with pytest.raises(StoreNetworkError):
+                call()
+        # the unmatched subtree is untouched
+        assert store.get("b/k")[0] == b"y"
+        store.put("b/k2", b"z")
+        # unbounded until healed — well past any hit-window default
+        for _ in range(5):
+            with pytest.raises(StoreNetworkError):
+                store.head("a/k")
+        assert store.injector.heal(rule) == 1
+        assert store.get("a/k")[0] == b"x"
+        assert store.head("a/k2") is None  # severed put never applied
+
+    def test_partition_nothing_applied(self):
+        store = FakeObjectStore()
+        store.injector.partition(match="v")
+        with pytest.raises(StoreNetworkError):
+            store.put("v1", b"x")
+        with pytest.raises(StoreNetworkError):
+            store.put_if("v2", b"x", if_absent=True)
+        store.injector.heal("v")
+        assert store.list() == []
+
+    def test_partition_whole_store_and_heal_by_match(self):
+        store = FakeObjectStore()
+        store.put("k", b"x")
+        store.injector.partition()
+        store.injector.partition()
+        with pytest.raises(StoreNetworkError):
+            store.get("k")
+        # heal(None) lifts every match-everything partition at once
+        assert store.injector.heal(None) == 2
+        assert store.get("k")[0] == b"x"
+
+    def test_partition_op_scoped(self):
+        """op="put" severs writes only — reads still answer (an
+        asymmetric partition, e.g. a read-only degraded mirror)."""
+        store = FakeObjectStore()
+        store.put("k", b"x")
+        rule = store.injector.partition(op="put")
+        with pytest.raises(StoreNetworkError):
+            store.put("k2", b"y")
+        assert store.get("k")[0] == b"x"
+        store.injector.heal(rule)
+        store.put("k2", b"y")
+
+    def test_partition_fired_log(self):
+        store = FakeObjectStore()
+        store.injector.partition(match="p/")
+        with pytest.raises(StoreNetworkError):
+            store.put("p/k", b"x")
+        kinds = [k for k, _op, _key, _hit in store.injector.fired]
+        assert "partition" in kinds
+
 
 class TestRetry:
     def _wrapped(self, *rules):
@@ -220,8 +286,9 @@ class TestRetry:
         with use_registry(_registry()) as reg:
             assert store.put("k", b"x") == token_of(b"x")
             assert reg.counter(
-                "tpudas_store_retries_total", "", labelnames=("op",)
-            ).value(op="put") == 3
+                "tpudas_store_retries_total", "",
+                labelnames=("op", "backend"),
+            ).value(op="put", backend="fake") == 3
         assert len(sleeps) == 3
         # capped-exponential backoff: non-decreasing, bounded
         assert sleeps == sorted(sleeps)
@@ -232,8 +299,15 @@ class TestRetry:
             FaultRule(kind="unavailable", op="get", times=99),
         )
         store.inner.put("k", b"x")
-        with pytest.raises(StoreNetworkError):
-            store.get("k")
+        with use_registry(_registry()) as reg:
+            with pytest.raises(StoreNetworkError):
+                store.get("k")
+            # the member is down: counted per backend so a replicated
+            # composite's failover is attributable in /metrics
+            assert reg.counter(
+                "tpudas_store_retry_exhausted_total", "",
+                labelnames=("op", "backend"),
+            ).value(op="get", backend="fake") == 1
 
     def test_lost_put_converges(self):
         store, _ = self._wrapped(FaultRule(kind="lost", op="put"))
@@ -251,8 +325,9 @@ class TestRetry:
             token = store.put_if("marker", b"mine", if_absent=True)
             assert token == token_of(b"mine")
             assert reg.counter(
-                "tpudas_store_cas_recovered_total", ""
-            ).value() == 1
+                "tpudas_store_cas_recovered_total", "",
+                labelnames=("backend",),
+            ).value(backend="fake") == 1
         assert store.inner.get("marker")[0] == b"mine"
         assert sleeps == []  # recovery is one head, no backoff
         # and the marker still refuses a second writer: exactly-once

@@ -246,8 +246,9 @@ class TestCommitRaces:
         with use_registry(MetricsRegistry()) as reg:
             assert q.commit(lease, staging) == "committed"
             assert reg.counter(
-                "tpudas_store_cas_recovered_total", ""
-            ).value() == 1
+                "tpudas_store_cas_recovered_total", "",
+                labelnames=("backend",),
+            ).value(backend="fake") == 1
         assert q.is_done(lease.shard)
 
     def test_crashed_commit_adopted(self, archive, tmp_path):

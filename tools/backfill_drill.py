@@ -42,7 +42,21 @@ its stitched result byte-identical to an unfaulted POSIX-store
 control.  ``audit_backfill_store`` must come back clean and the
 materialized result byte-identical to the sequential realtime run.
 
-``tests/test_integrity.py`` runs a 2-worker/2-kill smoke in tier-1.
+``--store --replicas N`` (ISSUE 20) runs the chaos leg against a
+``replica:`` store — one ``file://`` primary + N posix mirrors.  One
+mirror is SEVERED (its root replaced by a plain file, so every write
+from every worker subprocess fails into the hinted-handoff journal)
+for the whole SIGKILL window, healed after the queue resolves, and
+converged by drain + anti-entropy scrub; the drill then asserts every
+replica tree is byte-identical to the primary and the result
+byte-identical to the single-store control.  The in-process
+:func:`run_replica_drill` is the same story on fault-injected fakes
+(a ``partition`` rule severs one mirror) — it additionally proves the
+drain is zero-re-upload (a second drain moves nothing, the scrub
+repairs nothing).
+
+``tests/test_integrity.py`` runs a 2-worker/2-kill smoke in tier-1;
+``tests/test_store_replica.py`` runs the replica smoke.
 """
 
 from __future__ import annotations
@@ -609,6 +623,170 @@ def run_store_fault_matrix(src: str, n_files: int, workdir: str,
     }
 
 
+def _store_tree(store, prefix: str = "") -> dict:
+    """{key: sha256(bytes)} of every committed object — the replica
+    byte-identity comparison (tokens are crc-len; the drill compares
+    actual content digests)."""
+    import hashlib
+
+    return {
+        key: hashlib.sha256(store.get(key)[0]).hexdigest()
+        for key in store.list(prefix)
+    }
+
+
+def run_replica_drill(
+    shards: int = 2,
+    workers: int = 2,
+    workdir: str | None = None,
+    max_wall: float = 600.0,
+) -> dict:
+    """The in-process replication drill (tier-1 smoke): drain a small
+    job through a ``ReplicatedStore`` over three fakes with ONE MIRROR
+    SEVERED (an injector ``partition`` rule) for the whole run, then
+    heal → drain the hinted-handoff journal → anti-entropy scrub, and
+    assert:
+
+    - every deferred mirror write landed in the journal and drained
+      (``handoff_fully_drained``);
+    - the drain was zero-re-upload: a second drain moves nothing and
+      the scrub repairs nothing (``drain_idempotent``);
+    - all three replica trees are byte-identical to each other AND to
+      an unfaulted single-store control (``replicas_identical``,
+      ``outputs_match_control``);
+    - exactly ``shards`` commits happened across the workers — CAS
+      pinned to the primary lost/doubled nothing
+      (``commits_exact``)."""
+    import threading
+
+    from tpudas.backfill.objqueue import run_store_worker
+    from tpudas.integrity.audit import audit_backfill_store
+    from tpudas.store import (
+        FakeObjectStore,
+        RetryingStore,
+    )
+    from tpudas.store.replica import ReplicatedStore
+
+    n_files = int(round(shards * SHARD_SEC / FILE_SEC))
+    workdir = workdir or tempfile.mkdtemp(prefix="replica_drill_")
+    src = os.path.join(workdir, "src")
+    _build_archive(src, n_files)
+
+    # unfaulted single-store control
+    ctrl = RetryingStore(FakeObjectStore(), sleep_fn=lambda _s: None)
+    _plan_store(ctrl, "job", src, n_files)
+    run_store_worker(
+        ctrl, "job", scratch=os.path.join(workdir, "ctrl-scratch"),
+        worker="ctrl", max_wall=max_wall, idle_poll=0.02,
+        sleep_fn=lambda _s: None, lease_ttl=LEASE_TTL,
+    )
+    ctrl_res = os.path.join(workdir, "ctrl-result")
+    _materialize_result(ctrl, "job", ctrl_res)
+
+    # the replicated store: primary + 2 mirrors, each retry-wrapped
+    raws = [FakeObjectStore() for _ in range(3)]
+    members = [
+        RetryingStore(r, sleep_fn=lambda _s: None) for r in raws
+    ]
+    repl = ReplicatedStore(
+        members[0], members[1:],
+        journal_dir=os.path.join(workdir, "journal"),
+    )
+    _plan_store(repl, "job", src, n_files)
+    # sever mirror 0 AFTER the plan fanned out — mid-job, every
+    # subsequent write to it must fail into the handoff journal
+    rule = raws[1].injector.partition()
+
+    tallies = {}
+
+    def _drain_job(name):
+        tallies[name] = run_store_worker(
+            repl, "job",
+            scratch=os.path.join(workdir, f"scratch-{name}"),
+            worker=name, max_wall=max_wall, idle_poll=0.02,
+            sleep_fn=lambda _s: None, lease_ttl=LEASE_TTL,
+        )
+
+    threads = [
+        threading.Thread(target=_drain_job, args=(f"rw{i}",))
+        for i in range(int(workers))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    journaled = repl.journal.pending_counts()[0]
+    # heal, drain, prove idempotence, scrub
+    raws[1].injector.heal(rule)
+    drained = repl.drain_handoff()
+    drained_again = repl.drain_handoff()
+    scrub = repl.scrub("", repair=True)
+    audit = audit_backfill_store(repl, "job", repair=True)
+
+    res = os.path.join(workdir, "result")
+    _materialize_result(repl, "job", res)
+    trees = [_store_tree(m) for m in members]
+    commits = sum(
+        t["committed"] + t["adopted"] for t in tallies.values()
+    )
+    checks = {
+        "mirror_writes_journaled": journaled > 0,
+        "handoff_fully_drained": (
+            drained["copied"] + drained["deleted"]
+            + drained["already_synced"] + drained["vanished"] > 0
+            and drained["failed"] == 0
+            and not any(repl.journal.pending_counts().values())
+        ),
+        "drain_idempotent": (
+            all(v == 0 for v in drained_again.values())
+            and sum(scrub["repairs"].values()) == 0
+        ),
+        "scrub_clean": bool(scrub["clean"]),
+        "audit_clean": bool(audit["clean"]),
+        "replicas_identical": (
+            trees[0] == trees[1] == trees[2]
+            and repl.verify_identical()
+        ),
+        "outputs_match_control": (
+            _store_tree(repl, "job/result")
+            == _store_tree(ctrl, "job/result")
+        ),
+        "commits_exact": commits == int(shards),
+    }
+    return {
+        "mode": "replica",
+        "shards": int(shards),
+        "workers": int(workers),
+        "journaled": journaled,
+        "drained": drained,
+        "scrub": {
+            k: scrub[k] for k in ("repairs", "clean", "objects")
+        },
+        "tallies": {
+            k: {kk: t[kk] for kk in ("committed", "adopted", "lost")}
+            for k, t in tallies.items()
+        },
+        **checks,
+        "workdir": workdir,
+        "ok": all(checks.values()),
+    }
+
+
+def _sever_posix(root: str) -> None:
+    """Sever a posix mirror: its root becomes a plain FILE, so every
+    op from every process fails (makedirs/open raise OSError) instead
+    of silently recreating a fresh tree."""
+    os.rename(root, root + ".severed")
+    with open(root, "w") as fh:
+        fh.write("severed by backfill_drill\n")
+
+
+def _heal_posix(root: str) -> None:
+    os.unlink(root)
+    os.rename(root + ".severed", root)
+
+
 def run_store_backfill_drill(
     workers: int = 3,
     kills: int = 4,
@@ -617,13 +795,20 @@ def run_store_backfill_drill(
     workdir: str | None = None,
     log_path: str | None = None,
     max_wall: float = 1200.0,
+    replicas: int = 0,
 ) -> dict:
     """The object-store chaos drill: worker subprocesses sharing only
     a ``file://`` object store, SIGKILLed on a seeded schedule, with
     protocol-point deaths and per-worker network storms injected —
     then the audit must be clean and the result byte-identical to the
     POSIX-store control AND the sequential realtime run; the fake
-    fault-matrix leg rides on the same archive."""
+    fault-matrix leg rides on the same archive.
+
+    ``replicas=N`` (N >= 1) runs the same chaos against a
+    ``replica:`` store with N posix mirrors; mirror 1 is severed (root
+    replaced by a file) for the whole SIGKILL window and healed after
+    the queue resolves — the audit's scrub must then converge every
+    replica tree byte-identical to the primary."""
     import numpy as np
 
     from tools.crash_drill import (
@@ -636,6 +821,7 @@ def run_store_backfill_drill(
     from tpudas.store import store_from_url
 
     workers = int(workers)
+    replicas = int(replicas)
     n_files = int(round(shards * SHARD_SEC / FILE_SEC))
     workdir = workdir or tempfile.mkdtemp(
         prefix=f"store_drill_w{workers}_"
@@ -647,7 +833,25 @@ def run_store_backfill_drill(
     ready_dir = os.path.join(workdir, ".workers")
     seq = os.path.join(workdir, "seq")
     url = f"file://{bucket}"
+    mirror_roots = []
+    env_before = os.environ.get("TPUDAS_REPLICA_JOURNAL")
+    if replicas:
+        mirror_roots = [
+            os.path.join(workdir, f"bucket_m{i + 1}")
+            for i in range(replicas)
+        ]
+        url = "replica:" + ",".join(
+            [f"file://{bucket}"]
+            + [f"file://{r}" for r in mirror_roots]
+        )
+        # one shared journal dir: worker subprocesses append their
+        # own m<i>-<pid>.jsonl files there, the parent drains them —
+        # a killed worker's deferred writes survive its death
+        os.environ["TPUDAS_REPLICA_JOURNAL"] = os.path.join(
+            workdir, "handoff-journal"
+        )
     prefix = "job"
+    severed = False
     log_fh = open(log_path, "ab") if log_path else None
     try:
         _build_archive(src, n_files)
@@ -699,6 +903,13 @@ def run_store_backfill_drill(
 
         for _ in range(workers):
             spawn_one()
+        if replicas:
+            # sever mirror 1 for the ENTIRE chaos window: every
+            # worker's fan-out writes to it must fail into the
+            # shared handoff journal (or, for writes a SIGKILL raced,
+            # be found by the scrub's token diff)
+            _sever_posix(mirror_roots[0])
+            severed = True
         while True:
             if time.time() > deadline:
                 raise TimeoutError(
@@ -735,7 +946,32 @@ def run_store_backfill_drill(
             while len(procs) < workers and not (resolved and stitched):
                 spawn_one()
             time.sleep(0.05)
+        replica_block = None
+        if replicas:
+            # heal the severed mirror; the audit below runs the
+            # anti-entropy scrub (journal drain + token-diff repair)
+            _heal_posix(mirror_roots[0])
+            severed = False
         report = audit_backfill_store(store, prefix, repair=True)
+        if replicas:
+            from tpudas.store.replica import find_replicated
+
+            repl = find_replicated(store)
+            trees = [
+                _store_tree(m)
+                for m in (repl.primary, *repl.mirrors)
+            ]
+            replica_block = {
+                "replicas": replicas,
+                "scrub": {
+                    k: report["replication"][k]
+                    for k in ("drained", "repairs", "clean")
+                },
+                "handoff_pending": repl.journal.pending_counts(),
+                "replicas_identical": all(
+                    t == trees[0] for t in trees[1:]
+                ),
+            }
         res = os.path.join(workdir, "result")
         _materialize_result(store, prefix, res)
         over_s, wall_s = _store_overhead(store, prefix)
@@ -770,9 +1006,17 @@ def run_store_backfill_drill(
             and matrix["audit_clean"]
             and matrix["outputs_match_posix_control"]
             and matrix["pyramid_match_posix_control"]
+            and (replica_block is None or (
+                replica_block["replicas_identical"]
+                and replica_block["scrub"]["clean"]
+                and not any(
+                    replica_block["handoff_pending"].values()
+                )
+            ))
         )
         return {
-            "mode": "store",
+            "mode": "store" if not replicas else "store-replica",
+            "replication": replica_block,
             "workers": workers,
             "kills": kills_done,
             "kills_requested": int(kills),
@@ -795,6 +1039,13 @@ def run_store_backfill_drill(
             "ok": ok,
         }
     finally:
+        if severed:
+            _heal_posix(mirror_roots[0])
+        if replicas:
+            if env_before is None:
+                os.environ.pop("TPUDAS_REPLICA_JOURNAL", None)
+            else:
+                os.environ["TPUDAS_REPLICA_JOURNAL"] = env_before
         if log_fh is not None:
             log_fh.close()
 
@@ -813,11 +1064,22 @@ def main(argv=None) -> int:
              "fault-injected fake backend leg) instead of the "
              "shared-filesystem queue",
     )
+    ap.add_argument(
+        "--replicas", type=int, default=0, metavar="N",
+        help="with --store: drill a replica: store with N posix "
+             "mirrors, one severed for the SIGKILL window then "
+             "healed + scrubbed (ISSUE 20)",
+    )
     args = ap.parse_args(argv)
+    if args.replicas and not args.store:
+        ap.error("--replicas requires --store")
     run = run_store_backfill_drill if args.store else run_backfill_drill
+    kwargs = {}
+    if args.store:
+        kwargs["replicas"] = args.replicas
     rep = run(
         workers=args.workers, kills=args.kills, shards=args.shards,
-        seed=args.seed, log_path=args.log,
+        seed=args.seed, log_path=args.log, **kwargs,
     )
     print(json.dumps(
         {k: v for k, v in rep.items() if k != "workdir"}, indent=1

@@ -61,6 +61,8 @@ _HEADLINE_PATTERNS = (
     (re.compile(r"utilization", re.I), "up"),
     (re.compile(r"overhead", re.I), "down"),
     (re.compile(r"lag", re.I), "down"),
+    (re.compile(r"drain", re.I), "up"),
+    (re.compile(r"repair", re.I), "up"),
     (re.compile(r"spread", re.I), "down"),
     (re.compile(r"(^|_)p(50|90|95|99)(_|$)", re.I), "down"),
     (re.compile(r"(wall|_seconds|_s)$", re.I), "down"),

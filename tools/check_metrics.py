@@ -201,6 +201,20 @@ REQUIRED_METRICS = (
     "tpudas_live_resumes_total",
     "tpudas_live_publish_errors_total",
     "tpudas_lfproc_listener_errors_total",
+    # replicated store plane (PR 20): store_scrub.py and the drill key
+    # off the handoff/scrub counters, /healthz surfaces handoff_pending,
+    # RESILIENCE.md "Replication & DR" pages on divergence_total
+    "tpudas_store_retry_exhausted_total",
+    "tpudas_store_replica_mirrors",
+    "tpudas_store_replica_handoff_pending",
+    "tpudas_store_replica_handoff_journaled_total",
+    "tpudas_store_replica_handoff_drained_total",
+    "tpudas_store_replica_mirror_writes_total",
+    "tpudas_store_replica_failover_reads_total",
+    "tpudas_store_replica_divergence_total",
+    "tpudas_store_replica_scrub_runs_total",
+    "tpudas_store_replica_scrub_repairs_total",
+    "tpudas_store_replica_promotions_total",
 )
 REQUIRED_SPANS = (
     "serve.request",
@@ -243,6 +257,9 @@ REQUIRED_SPANS = (
     # live push plane (PR 19)
     "live.publish",
     "live.fanout",
+    # replicated store plane (PR 20)
+    "store.replicate",
+    "store.scrub",
 )
 
 

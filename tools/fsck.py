@@ -24,10 +24,16 @@ Options:
     --store URL     audit an OBJECT-STORE backfill job instead: the
                     positional argument is the job prefix inside the
                     store named by URL (file:///path, s3://bucket/...,
-                    fake:tag); classifies torn markers/leases, crashed
-                    commits, orphan objects, and torn partial uploads
-                    from list() + content-token verification
-                    (tpudas.integrity.audit.audit_backfill_store)
+                    fake:tag, replica:urlA,urlB,...); classifies torn
+                    markers/leases, crashed commits, orphan objects,
+                    and torn partial uploads from list() +
+                    content-token verification
+                    (tpudas.integrity.audit.audit_backfill_store).
+                    A replica: URL additionally runs the anti-entropy
+                    scrub (drain handoff journal, repair divergent
+                    mirrors, sweep debris on every replica) and folds
+                    its verdict into "clean" — see also
+                    tools/store_scrub.py for scrub/promotion alone
     --out PATH      also write the JSON report to PATH
 
 Run only while the driver is stopped: the stale-tmp sweep cannot tell

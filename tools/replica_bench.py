@@ -1,0 +1,264 @@
+"""Replicated-store plane benchmark: steady write-through overhead,
+hinted-handoff drain, anti-entropy scrub, and replication lag.
+
+Produces ``BENCH_pr20.json`` (ISSUE 20 acceptance artifact):
+
+- ``steady_overhead`` — what mirroring every committed write to two
+  extra backends COSTS on the steady path: the pyramid publish is run
+  against a single ``file://`` store and against the same store
+  wrapped in a 3-way :class:`~tpudas.store.replica.ReplicatedStore`,
+  and the added wall is amortized over the steady processing round
+  the publisher piggybacks on (the lowpass driver pass, same
+  denominator as ``BENCH_pr18.json``'s retry leg).  Acceptance:
+  < 2%.
+- ``handoff_drain`` — a mirror is partitioned mid-publish so every
+  write it misses lands in the hinted-handoff journal; after heal the
+  drain pass is timed (``handoff_drain_rate`` objects/s), re-run to
+  prove idempotence (zero re-uploads), and the sever→converged wall
+  is recorded as ``replication_lag_s``.
+- ``scrub`` — a deterministic divergence matrix (8 missing, 4
+  mismatched, 1 primary-lost object) repaired by one anti-entropy
+  pass; ``scrub_repairs`` is the repair count (deterministic by
+  construction) and the trees must verify byte-identical after.
+
+Gate it against the trail with::
+
+    JAX_PLATFORMS=cpu python tools/replica_bench.py
+    python tools/bench_history.py --gate BENCH_pr20.json
+
+Run from the repo root (CPU is fine).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tpudas.obs.registry import (  # noqa: E402
+    MetricsRegistry,
+    use_registry,
+)
+from tpudas.proc.streaming import run_lowpass_realtime  # noqa: E402
+from tpudas.serve.tiles import sync_pyramid  # noqa: E402
+from tpudas.store import (  # noqa: E402
+    FakeObjectStore,
+    PyramidPublisher,
+    store_from_url,
+)
+from tpudas.store.replica import ReplicatedStore  # noqa: E402
+from tpudas.testing import make_synthetic_spool  # noqa: E402
+
+T0 = "2023-03-22T00:00:00"
+FS = 100.0
+FILE_SEC = 30.0
+N_FILES = 10
+N_CH = 128
+DT_OUT = 0.1
+TILE_LEN = 128
+PREFIX = "streams/a"
+PUBLISH_ROUNDS = 5
+
+
+def _counter_value(reg, name, labelnames=(), **labels) -> float:
+    try:
+        metric = reg.counter(name, "", labelnames=tuple(labelnames))
+    except ValueError:
+        return 0.0
+    try:
+        return float(metric.value(**labels))
+    except (KeyError, ValueError):
+        return 0.0
+
+
+def build_pyramid(workdir: str) -> tuple:
+    """Synthesize the archive, run the lowpass driver, build the tile
+    pyramid; returns ``(stream_folder, driver_wall_s)`` — the steady
+    processing round that is the overhead denominator."""
+    src = os.path.join(workdir, "raw")
+    out = os.path.join(workdir, "stream")
+    make_synthetic_spool(
+        src, n_files=N_FILES, file_duration=FILE_SEC, fs=FS,
+        n_ch=N_CH, noise=0.01,
+    )
+    t0 = time.perf_counter()
+    run_lowpass_realtime(
+        source=src, output_folder=out, start_time=T0,
+        output_sample_interval=DT_OUT, edge_buffer=5.0,
+        process_patch_size=64, poll_interval=0.0,
+        sleep_fn=lambda _s: None, pyramid=False,
+    )
+    driver_wall = time.perf_counter() - t0
+    sync_pyramid(out, tile_len=TILE_LEN)
+    return out, driver_wall
+
+
+def bench_steady_overhead(stream: str, workdir: str,
+                          steady_round_wall: float) -> dict:
+    """Publish into a bare ``file://`` store vs a 3-way replicated
+    one; the added wall amortized over the steady round must stay
+    under 2%."""
+
+    def publish_rounds(make_store) -> float:
+        walls = []
+        for i in range(PUBLISH_ROUNDS):
+            base = tempfile.mkdtemp(prefix="replica-bench-pub-",
+                                    dir=workdir)
+            store = make_store(base)
+            t0 = time.perf_counter()
+            PyramidPublisher(store, PREFIX, stream).publish()
+            walls.append(time.perf_counter() - t0)
+            shutil.rmtree(base, ignore_errors=True)
+        walls.sort()
+        return walls[len(walls) // 2]  # median
+
+    single_wall = publish_rounds(
+        lambda base: store_from_url(f"file://{base}/bucket")
+    )
+    journal = os.path.join(workdir, "overhead-journal")
+    repl_wall = publish_rounds(
+        lambda base: store_from_url(
+            f"replica:file://{base}/bucket,"
+            f"file://{base}/m1,file://{base}/m2"
+        )
+    )
+    shutil.rmtree(journal, ignore_errors=True)
+    added = max(repl_wall - single_wall, 0.0)
+    frac = added / steady_round_wall if steady_round_wall else 0.0
+    return {
+        "publish_rounds": PUBLISH_ROUNDS,
+        "steady_round_wall_s": round(steady_round_wall, 3),
+        "single_publish_wall_s": round(single_wall, 4),
+        "replicated_publish_wall_s": round(repl_wall, 4),
+        "added_wall_s": round(added, 4),
+        "replication_overhead_fraction": round(frac, 5),
+        "accept_under_2pct": frac < 0.02,
+    }
+
+
+def bench_handoff_drain(stream: str, workdir: str) -> dict:
+    """Partition a mirror mid-publish, heal, drain; drain rate,
+    idempotence, and sever→converged lag."""
+    reg = MetricsRegistry()
+    with use_registry(reg):
+        raws = [FakeObjectStore() for _ in range(3)]
+        repl = ReplicatedStore(
+            raws[0], raws[1:],
+            journal_dir=os.path.join(workdir, "drain-journal"),
+        )
+        rule = raws[1].injector.partition()
+        t_sever = time.perf_counter()
+        PyramidPublisher(repl, PREFIX, stream).publish()
+        journaled = _counter_value(
+            reg, "tpudas_store_replica_handoff_journaled_total",
+            labelnames=("mirror",), mirror="m0",
+        )
+        raws[1].injector.heal(rule)
+        t0 = time.perf_counter()
+        first = repl.drain_handoff()
+        drain_wall = time.perf_counter() - t0
+        lag = time.perf_counter() - t_sever
+        second = repl.drain_handoff()
+        scrub = repl.scrub("", repair=True)
+    resolved = first["copied"] + first["deleted"] + first["vanished"]
+    rate = resolved / drain_wall if drain_wall else 0.0
+    return {
+        "journaled_writes": int(journaled),
+        "first_drain": first,
+        "drain_wall_s": round(drain_wall, 4),
+        "handoff_drain_rate": round(rate, 1),
+        "replication_lag_s": round(lag, 4),
+        "second_drain": second,
+        "accept_drain_idempotent": not any(
+            second[k] for k in ("copied", "deleted", "failed")
+        ),
+        "accept_zero_failed": first["failed"] == 0,
+        "accept_converged": bool(scrub["clean"]),
+    }
+
+
+def bench_scrub(workdir: str) -> dict:
+    """A deterministic divergence matrix repaired by one anti-entropy
+    pass: 8 missing + 4 mismatched on the mirror, 1 object the
+    primary lost."""
+    reg = MetricsRegistry()
+    with use_registry(reg):
+        raws = [FakeObjectStore() for _ in range(2)]
+        repl = ReplicatedStore(
+            raws[0], raws[1:],
+            journal_dir=os.path.join(workdir, "scrub-journal"),
+        )
+        for i in range(24):
+            repl.put(f"{PREFIX}/obj-{i:03d}", b"x" * 512 + bytes([i]))
+        # fabricate divergence behind the journal's back
+        for i in range(8):
+            raws[1]._objects.pop(f"{PREFIX}/obj-{i:03d}")
+        for i in range(8, 12):
+            raws[1]._objects[f"{PREFIX}/obj-{i:03d}"] = b"stale"
+        raws[1]._objects[f"{PREFIX}/lost"] = b"only-on-mirror"
+        t0 = time.perf_counter()
+        report = repl.scrub("", repair=True)
+        scrub_wall = time.perf_counter() - t0
+        repairs = sum(report["repairs"].values())
+        identical = repl.verify_identical()
+    return {
+        "objects": report["objects"],
+        "scrub_wall_s": round(scrub_wall, 4),
+        "repair_matrix": report["repairs"],
+        "scrub_repairs": int(repairs),
+        "accept_clean": bool(report["clean"]),
+        "accept_identical_after": bool(identical),
+    }
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    out_path = argv[0] if argv else os.path.join(
+        REPO, "BENCH_pr20.json"
+    )
+    workdir = tempfile.mkdtemp(prefix="replica-bench-")
+    bench_t0 = time.perf_counter()
+    try:
+        stream, driver_wall = build_pyramid(workdir)
+        overhead = bench_steady_overhead(stream, workdir, driver_wall)
+        drain = bench_handoff_drain(stream, workdir)
+        scrub = bench_scrub(workdir)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    doc = {
+        "bench": "replicated_store_plane",
+        "config": {
+            "fs": FS, "n_files": N_FILES, "file_sec": FILE_SEC,
+            "n_ch": N_CH, "dt_out": DT_OUT, "tile_len": TILE_LEN,
+            "mirrors": 2, "publish_rounds": PUBLISH_ROUNDS,
+        },
+        "steady_overhead": overhead,
+        "handoff_drain": drain,
+        "scrub": scrub,
+        "ok": bool(
+            overhead["accept_under_2pct"]
+            and drain["accept_drain_idempotent"]
+            and drain["accept_zero_failed"]
+            and drain["accept_converged"]
+            and scrub["accept_clean"]
+            and scrub["accept_identical_after"]
+        ),
+        "bench_wall_s": round(time.perf_counter() - bench_t0, 1),
+    }
+    with open(out_path, "w") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=False)
+        fh.write("\n")
+    print(json.dumps(doc, indent=1))
+    print(f"\nwrote {out_path}; ok={doc['ok']}")
+    return 0 if doc["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

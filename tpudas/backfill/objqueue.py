@@ -769,6 +769,15 @@ def run_store_worker(store, prefix: str, scratch=None,
         result = stitch_store_backfill(store, prefix, queue=queue)
         tally["stitched"] = result["status"] in ("committed", "already")
         tally["stitch_status"] = result["status"]
+    # replicated store: before this worker exits, push any writes a
+    # down mirror missed (the handoff journal) at mirrors that have
+    # healed meanwhile — workers drain their own debt, scrub only
+    # mops up after crashes
+    from tpudas.store.replica import find_replicated
+
+    repl = find_replicated(store)
+    if repl is not None:
+        tally["handoff_drained"] = repl.drain_handoff()
     tally["counts"] = queue.counts()
     log_event("backfill_worker_done", **{
         k: v for k, v in tally.items() if k != "counts"

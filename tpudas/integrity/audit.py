@@ -1682,6 +1682,26 @@ def audit_backfill_store(store, prefix, repair: bool = True,
                     _repair_action(repair, "removed"),
                     "result object not named by the result manifest",
                 )
+    # replicated store: follow the structural audit with an
+    # anti-entropy scrub so fsck leaves every mirror converged too
+    # (the scrub drains the handoff journal first; repair follows the
+    # fsck repair flag)
+    replication = None
+    from tpudas.store.replica import find_replicated
+
+    repl = find_replicated(store)
+    if repl is not None:
+        try:
+            replication = repl.scrub(prefix, repair=repair)
+        except Exception as exc:
+            log_event(
+                "store_scrub_failed",
+                error=f"{type(exc).__name__}: {str(exc)[:200]}",
+            )
+            replication = {
+                "clean": False,
+                "error": f"{type(exc).__name__}: {str(exc)[:200]}",
+            }
     elapsed = time.perf_counter() - t0
     get_registry().counter(
         "tpudas_integrity_audit_runs_total",
@@ -1692,7 +1712,7 @@ def audit_backfill_store(store, prefix, repair: bool = True,
     )
     clean = error is None and all(
         it["action"] in _REPAIRED_ACTIONS for it in issues
-    )
+    ) and (replication is None or bool(replication.get("clean")))
     report = {
         "root": root,
         "repair": bool(repair),
@@ -1704,6 +1724,8 @@ def audit_backfill_store(store, prefix, repair: bool = True,
         "counts": queue.counts() if queue is not None else {},
         "issues_total": len(issues),
     }
+    if replication is not None:
+        report["replication"] = replication
     if error is not None:
         report["error"] = error
     if report["issues_total"]:

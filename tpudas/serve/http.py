@@ -1189,6 +1189,12 @@ class DASServer:
     before data queries, and keeps serving the mirror (flagged
     ``degraded`` in ``/healthz``'s ``store`` block) when the cold
     tier is unreachable.  See SERVING.md "Object-store serving".
+    A ``replica:urlA,urlB,...`` store URL serves through a
+    :class:`~tpudas.store.replica.ReplicatedStore` — reads fail over
+    primary → mirrors → the cache's stale-but-verified rung, and the
+    ``store`` block of ``/healthz`` grows a ``replication`` entry
+    (mirror list, handoff backlog, failover/divergence counts, last
+    scrub).  See SERVING.md "Multi-region serving".
     """
 
     def __init__(self, folder=None, host="127.0.0.1", port=0,

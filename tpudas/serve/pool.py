@@ -552,7 +552,10 @@ def main(argv=None) -> int:
     ap.add_argument("--store-url", default=None,
                     help="serve a remote pyramid from this object "
                          "store; each worker hydrates its own "
-                         "mirror + cache (stateless replicas)")
+                         "mirror + cache (stateless replicas); "
+                         "replica:urlA,urlB,... serves through a "
+                         "replicated store with mirror failover "
+                         "(SERVING.md multi-region recipe)")
     ap.add_argument("--store-prefix", default="",
                     help="stream prefix inside the store")
     ap.add_argument("--cache-dir", default=None,

@@ -7,6 +7,8 @@ Public surface:
 - :mod:`tpudas.store.posix` / :mod:`tpudas.store.s3` /
   :mod:`tpudas.store.fake` — the three backends;
 - :mod:`tpudas.store.retry` — idempotency-aware network-error retry;
+- :mod:`tpudas.store.replica` — primary + N-mirror replication with
+  hinted handoff, anti-entropy scrub, and promotion;
 - :mod:`tpudas.store.cache` — the NVMe read-through tier;
 - :mod:`tpudas.store.tileplane` — the pyramid publisher and the
   remote (multi-host) pyramid reader;
@@ -26,6 +28,7 @@ from tpudas.store.base import (
 from tpudas.store.cache import ReadThroughCache
 from tpudas.store.fake import FakeObjectStore, FaultInjector, FaultRule
 from tpudas.store.posix import PosixStore
+from tpudas.store.replica import ReplicatedStore, find_replicated
 from tpudas.store.retry import STORE_RETRY_POLICY, RetryingStore
 from tpudas.store.tileplane import PyramidPublisher, RemotePyramid
 
@@ -40,10 +43,12 @@ __all__ = [
     "PyramidPublisher",
     "ReadThroughCache",
     "RemotePyramid",
+    "ReplicatedStore",
     "RetryingStore",
     "STORE_RETRY_POLICY",
     "StoreError",
     "StoreNetworkError",
+    "find_replicated",
     "store_from_url",
     "token_of",
 ]
@@ -62,11 +67,34 @@ def store_from_url(url: str, retry: bool = True,
     - ``s3://bucket/prefix`` → :class:`S3Store` (needs boto3 or an
       injected client — construct directly for the latter);
     - ``fake:`` / ``fake:tag`` → a process-shared
-      :class:`FakeObjectStore` per tag (tests, drills).
+      :class:`FakeObjectStore` per tag (tests, drills);
+    - ``replica:urlA,urlB,...`` → a
+      :class:`~tpudas.store.replica.ReplicatedStore` over the listed
+      members — FIRST is the primary, the rest are mirrors (any mix
+      of the schemes above).  Each member is built through this
+      function (so each is individually retry-wrapped when
+      ``retry=True``); the composite itself is never retry-wrapped —
+      the members already absorb transient faults, and a member that
+      stays down is what the handoff journal and failover ladder are
+      for.  The handoff journal lives under ``TPUDAS_REPLICA_JOURNAL``
+      (a fresh tempdir otherwise).
 
     ``retry=False`` returns the raw backend (drills that must see
     every injected fault exactly once)."""
     url = str(url)
+    if url.startswith("replica:"):
+        specs = [s.strip() for s in url[len("replica:"):].split(",")]
+        specs = [s for s in specs if s]
+        if len(specs) < 2:
+            raise StoreError(
+                f"replica url needs a primary and >=1 mirror: {url!r}"
+            )
+        members = [
+            store_from_url(s, retry=retry, policy=policy,
+                           sleep_fn=sleep_fn)
+            for s in specs
+        ]
+        return ReplicatedStore(members[0], members[1:])
     if url.startswith("fake:"):
         tag = url[len("fake:"):]
         store = _FAKES.get(tag)

@@ -18,13 +18,20 @@ store exhibits:
   classify the debris.
 - ``"latency"`` — the call sleeps first (a slow cold tier; not a
   failure).
+- ``"partition"`` — ``offline`` scoped by the rule's (op, match)
+  filter: every accepted call raises :class:`StoreNetworkError`
+  before anything applies, with an UNBOUNDED window by default.  This
+  is how replication drills sever ONE mirror (or one key prefix)
+  while the rest of the fake keeps answering — add with
+  :meth:`FaultInjector.partition`, lift with
+  :meth:`FaultInjector.heal`.
 
 Rules fire by (op, key-substring) with 1-based hit windows, mirroring
 :class:`tpudas.resilience.faults.FaultSpec` so drill scripts read the
 same either way.  ``offline=True`` fails EVERY call — the
 cold-tier-down drill the cache's stale-serving ladder is tested
 against.  All mutations of the injector are thread-safe; drills flip
-``offline`` while reader threads run.
+``offline`` (or partition rules) while reader threads run.
 """
 
 from __future__ import annotations
@@ -43,7 +50,7 @@ from tpudas.store.base import (
 
 __all__ = ["FakeObjectStore", "FaultInjector", "FaultRule"]
 
-_KINDS = ("unavailable", "lost", "torn", "latency")
+_KINDS = ("unavailable", "lost", "torn", "latency", "partition")
 
 
 @dataclass
@@ -88,6 +95,37 @@ class FaultInjector:
         with self._lock:
             self.offline = bool(offline)
 
+    def partition(self, match: str | None = None,
+                  op: str | None = None) -> FaultRule:
+        """Sever every call accepted by (op, match) until healed — an
+        unbounded ``partition`` rule.  ``match=None`` partitions the
+        whole store (equivalent to ``offline`` but heal-able per
+        rule); a key-prefix ``match`` severs one subtree while the
+        rest keeps answering.  Returns the rule for
+        :meth:`heal`."""
+        rule = FaultRule("partition", op=op, match=match,
+                         at=1, times=1 << 30)
+        self.add(rule)
+        return rule
+
+    def heal(self, rule_or_match) -> int:
+        """Remove partition rules: by the exact rule object
+        :meth:`partition` returned, or every partition rule whose
+        ``match`` equals the given string (None heals the
+        match-everything rules).  Returns how many were lifted."""
+        with self._lock:
+            if isinstance(rule_or_match, FaultRule):
+                doomed = [r for r in self.rules if r is rule_or_match]
+            else:
+                doomed = [
+                    r for r in self.rules
+                    if r.kind == "partition"
+                    and r.match == rule_or_match
+                ]
+            for r in doomed:
+                self.rules.remove(r)
+        return len(doomed)
+
     def _match(self, op: str, key: str):
         """Advance matching rules; return the kinds due to fire, in
         rule order, latency first so a slow-then-dead tier scripts
@@ -125,6 +163,11 @@ class FaultInjector:
                 raise StoreNetworkError(
                     f"injected 5xx before {op} {key!r} "
                     f"(hit {rule.hits})"
+                )
+            elif rule.kind == "partition":
+                raise StoreNetworkError(
+                    f"injected partition before {op} {key!r} "
+                    f"(match {rule.match!r})"
                 )
             else:
                 deferred.append(rule)

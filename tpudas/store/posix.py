@@ -49,7 +49,14 @@ class PosixStore(ObjectStore):
     def __init__(self, root: str, durable: bool = False):
         self.root = os.path.abspath(str(root))
         self.durable = bool(durable)
-        os.makedirs(self.root, exist_ok=True)
+        # Creating the root is best-effort: a replica member whose
+        # filesystem is currently unavailable must still CONSTRUCT so
+        # writes can be journaled for hinted handoff — the op-time
+        # OSError is the honest failure signal, not __init__.
+        try:
+            os.makedirs(self.root, exist_ok=True)
+        except OSError:
+            pass
 
     def _path(self, key: str) -> str:
         return os.path.join(self.root, *key.split("/"))

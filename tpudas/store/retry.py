@@ -72,12 +72,23 @@ class RetryingStore(ObjectStore):
         self.backend = f"retry+{inner.backend}"
 
     # -- retry machinery ----------------------------------------------
+    # both counters carry the wrapped backend's name, so a replicated
+    # composite's failover is attributable per member in /metrics
     def _count_retry(self, op: str) -> None:
         get_registry().counter(
             "tpudas_store_retries_total",
             "store calls re-issued after a network error",
-            labelnames=("op",),
-        ).inc(op=op)
+            labelnames=("op", "backend"),
+        ).inc(op=op, backend=self.inner.backend)
+
+    def _count_exhausted(self, op: str) -> None:
+        get_registry().counter(
+            "tpudas_store_retry_exhausted_total",
+            "store calls that failed every retry attempt "
+            "(the member is considered down; replication's handoff "
+            "journal / failover ladder takes over)",
+            labelnames=("op", "backend"),
+        ).inc(op=op, backend=self.inner.backend)
 
     def _blind(self, op: str, fn):
         """Retry an idempotent call until it answers or patience runs
@@ -89,6 +100,7 @@ class RetryingStore(ObjectStore):
                 return fn()
             except StoreNetworkError as exc:
                 if attempt + 1 >= attempts:
+                    self._count_exhausted(op)
                     raise
                 self._count_retry(op)
                 delay = self.policy.delay(attempt)
@@ -160,6 +172,7 @@ class RetryingStore(ObjectStore):
                     self._recovered(key, attempt)
                     return mine
                 if attempt + 1 >= attempts:
+                    self._count_exhausted("cas")
                     raise
                 self._count_retry("cas")
                 delay = self.policy.delay(attempt)
@@ -175,7 +188,8 @@ class RetryingStore(ObjectStore):
             "tpudas_store_cas_recovered_total",
             "conditional puts whose response was lost but whose write "
             "was confirmed landed by token re-read",
-        ).inc()
+            labelnames=("backend",),
+        ).inc(backend=self.inner.backend)
         log_event("store_cas_recovered", key=key, attempt=attempt + 1)
 
     def _current_token_or_none(self, key: str):

@@ -440,6 +440,11 @@ class RemotePyramid:
         }
         if self.cache is not None:
             out["cache"] = self.cache.snapshot()
+        from tpudas.store.replica import find_replicated
+
+        repl = find_replicated(self.store)
+        if repl is not None:
+            out["replication"] = repl.snapshot()
         return out
 
 
